@@ -1,0 +1,24 @@
+"""distributed_pytorch_training_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX / XLA / Pallas re-design of the capabilities of the reference
+repo ``yamiel-abreu/distributed-pytorch-training`` (a torch.distributed / NCCL
+DDP training script, /root/reference/train_ddp.py). This is NOT a port: where
+the reference uses one-process-per-GPU + NCCL + a DDP gradient-hook reducer,
+this framework uses one-process-per-host, a `jax.sharding.Mesh` over TPU chips,
+pure jitted train steps with `NamedSharding`, and XLA-inserted collectives over
+ICI/DCN.
+
+Subpackages
+-----------
+runtime    process/device runtime (maps train_ddp.py:49-73)
+parallel   mesh, collectives, sharding rules (maps train_ddp.py:159-167, 303-311)
+data       input pipeline (maps train_ddp.py:81-150)
+models     model zoo: ResNet-18/50, ViT-B/16, BERT-base, GPT-2 (maps :153-156)
+ops        Pallas TPU kernels (ring/flash attention, fused ops)
+training   train/eval loops, optimizers, checkpointing (maps :170-300, 314-390)
+utils      config, metrics, logging, profiling (maps :19-46, 224-262, 348-384)
+"""
+
+__version__ = "0.1.0"
+
+from . import parallel, runtime  # noqa: F401

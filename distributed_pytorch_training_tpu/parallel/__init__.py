@@ -1,0 +1,41 @@
+"""Parallelism layer: device mesh, collectives, sharding rules.
+
+TPU-native replacement for the reference's NCCL process group + DDP reducer
+(/root/reference/train_ddp.py:65, :303-311). Parallelism here is expressed as
+a named `jax.sharding.Mesh` plus `PartitionSpec` rules; XLA inserts and
+overlaps the collectives that DDP's C++ reducer performs by hand.
+"""
+
+from .mesh import (  # noqa: F401
+    DATA,
+    EXPERT,
+    FSDP,
+    MODEL,
+    PIPE,
+    SEQ,
+    MeshSpec,
+    batch_shard_count,
+    build_mesh,
+    local_batch_size,
+)
+from .collectives import (  # noqa: F401
+    all_to_all,
+    barrier,
+    broadcast_from_main,
+    host_all_gather,
+    pmax,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scalar,
+)
+from .sharding import (  # noqa: F401
+    PartitionRules,
+    batch_sharding,
+    batch_spec,
+    replicated,
+    shard_batch,
+    shard_pytree,
+    spec_for_path,
+    tree_specs,
+)

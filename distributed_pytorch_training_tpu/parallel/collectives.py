@@ -1,0 +1,146 @@
+"""Collectives — the visible-API parity surface for the reference's NCCL usage.
+
+The reference touches NCCL in four ways (/root/reference/train_ddp.py):
+(a) rendezvous (:65)        -> runtime.dist.setup_distributed
+(b) dist.barrier (:112)     -> `barrier()` here (host-level sync)
+(c) DDP bucketed gradient all-reduce (:305-310, implicit C++ reducer)
+                            -> NOT an API here at all: gradients sync because
+                               the batch is sharded over the mesh and the loss
+                               mean contracts over the global batch — XLA
+                               inserts (and overlaps) the all-reduce.
+(d) scalar metric all-reduce via `reduce_tensor` (:159-167, :251-253, :290-292)
+                            -> `psum`/`pmean` (in-jit) and `reduce_scalar`
+                               (host-level), both with the reference's
+                               "identity when single-device" convention
+                               (ref :164-165).
+
+Two distinct layers, never to be confused:
+
+* **In-program collectives** (`psum`, `pmean`, `pmax`, `ppermute_ring`,
+  `all_to_all`): used inside `shard_map`-ped functions where mesh axis names
+  are bound. These lower to XLA collectives riding ICI.
+* **Host-level collectives** (`barrier`, `broadcast_from_main`,
+  `host_all_gather`, `reduce_scalar`): process-level synchronization across
+  hosts, used for data-download gating (ref :111-112) and metric fan-in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _axes_present(axis_name: AxisName, mesh: Optional[Mesh]) -> bool:
+    """Static (trace-time) check: does `axis_name` have size > 1?
+
+    Implements the reference's single-process passthrough
+    (train_ddp.py:164-165) as a *compile-time* no-op rather than a runtime
+    branch — XLA never even sees a collective on trivial axes.
+    """
+    if mesh is None:
+        return True  # caller is inside shard_map and asserts the axis exists
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    unknown = [n for n in names if n not in mesh.shape]
+    if unknown:
+        # A typo'd axis must not silently become a no-op — that would
+        # silently disable gradient sync.
+        raise KeyError(f"axis {unknown} not in mesh axes {tuple(mesh.shape)}")
+    return any(mesh.shape[n] > 1 for n in names)
+
+
+def psum(x: Any, axis_name: AxisName, *, mesh: Optional[Mesh] = None) -> Any:
+    """SUM all-reduce over mesh axes (maps reduce_tensor, train_ddp.py:159-167).
+
+    Identity when the axes are trivial, mirroring ref :164-165.
+    """
+    if not _axes_present(axis_name, mesh):
+        return x
+    return lax.psum(x, axis_name)
+
+
+def pmean(x: Any, axis_name: AxisName, *, mesh: Optional[Mesh] = None) -> Any:
+    """MEAN all-reduce (the gradient-sync op DDP performs implicitly)."""
+    if not _axes_present(axis_name, mesh):
+        return x
+    return lax.pmean(x, axis_name)
+
+
+def pmax(x: Any, axis_name: AxisName, *, mesh: Optional[Mesh] = None) -> Any:
+    if not _axes_present(axis_name, mesh):
+        return x
+    return lax.pmax(x, axis_name)
+
+
+def ppermute_ring(x: Any, axis_name: str, *, shift: int = 1) -> Any:
+    """Rotate `x` around the ring of `axis_name` — the building block of ring
+    attention (KV blocks circulate over the ICI ring). No NCCL analogue in the
+    reference (max sequence there is a 32x32 image); this is the long-context
+    primitive SURVEY.md §5 requires."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x: Any, axis_name: str, split_axis: int, concat_axis: int) -> Any:
+    """All-to-all over a mesh axis — the Ulysses (head-sharding) primitive."""
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Host-level (cross-process) collectives.
+# ---------------------------------------------------------------------------
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process arrives (maps dist.barrier, train_ddp.py:112).
+
+    Single-process: immediate return (ref is_distributed() gate, :111).
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_from_main(x: Any) -> Any:
+    """Process-0 value to every process (DDP broadcasts params rank0->all at
+    wrap time, train_ddp.py:305-310; we broadcast explicitly at init)."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(x)
+
+
+def host_all_gather(x: Any) -> Any:
+    """Gather a host value from every process -> stacked numpy array."""
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda a: np.asarray(a)[None], x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def reduce_scalar(x: Union[float, int, jnp.ndarray], op: str = "sum") -> float:
+    """Host-level scalar reduction across processes — the literal parity API
+    for `reduce_tensor` (train_ddp.py:159-167): SUM all-reduce, identity when
+    single-process. Used for end-of-epoch metric fan-in (ref :251-253)."""
+    val = float(np.asarray(x))
+    if jax.process_count() == 1:
+        return val
+    gathered = np.asarray(host_all_gather(val))
+    if op == "sum":
+        return float(gathered.sum())
+    if op == "max":
+        return float(gathered.max())
+    if op == "mean":
+        return float(gathered.mean())
+    raise ValueError(f"unknown op {op!r}")

@@ -1,0 +1,155 @@
+"""Device mesh construction.
+
+The reference has no mesh: DDP is a flat world of one-process-per-GPU over
+NCCL (/root/reference/train_ddp.py:65). The TPU-native design makes the device
+topology explicit as a named `jax.sharding.Mesh`; every parallelism strategy
+(DP / FSDP-style / TP / SP / PP / EP) is an axis of that mesh, and a model's
+PartitionSpecs say which axes each tensor dimension is split over.
+
+Axis naming convention (used by all partition rules in `models/`):
+
+* ``data``  — data parallelism: batch dimension sharded; gradient psum rides
+              this axis (the DDP all-reduce equivalent, ref :305-310).
+* ``fsdp``  — parameter/optimizer-state sharding (ZeRO-ish); batch is sharded
+              over (data, fsdp) jointly, params gathered per-layer by XLA.
+* ``model`` — tensor parallelism (megatron-style split of weight matrices).
+* ``seq``   — sequence/context parallelism (ring attention KV rotation).
+* ``pipe``  — pipeline stages.
+* ``expert``— expert parallelism for MoE layers.
+
+Axis order in the physical mesh matters on TPU: `mesh_utils.create_device_mesh`
+maps the *last* axes onto the tightest ICI rings, so the most
+communication-hungry axes (model, seq) go last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis names.
+DATA = "data"
+FSDP = "fsdp"
+MODEL = "model"
+SEQ = "seq"
+PIPE = "pipe"
+EXPERT = "expert"
+
+# The order axes are laid out in the physical mesh — bandwidth-hungry last.
+AXIS_ORDER: tuple[str, ...] = (PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
+
+# Axes a batch dimension may be sharded over (see sharding.batch_spec).
+BATCH_AXES: tuple[str, ...] = (DATA, FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. ``-1`` on exactly one axis means "all remaining
+    devices". The default is pure data parallelism — the reference's only
+    strategy (SURVEY.md §2c)."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def resolved(self, n_devices: int) -> dict[str, int]:
+        sizes = {
+            PIPE: self.pipe,
+            DATA: self.data,
+            FSDP: self.fsdp,
+            EXPERT: self.expert,
+            SEQ: self.seq,
+            MODEL: self.model,
+        }
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices but {n_devices} are present"
+            )
+        return sizes
+
+    @staticmethod
+    def parse(text: str) -> "MeshSpec":
+        """Parse ``"data=4,model=2"`` (CLI ``--mesh`` flag)."""
+        valid = {f.name for f in dataclasses.fields(MeshSpec)}
+        kwargs = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, eq, v = part.partition("=")
+            k = k.strip()
+            if k not in valid:
+                raise ValueError(
+                    f"--mesh: unknown axis {k!r}; valid axes: {sorted(valid)}"
+                )
+            if not eq or not v.strip().lstrip("-").isdigit():
+                raise ValueError(
+                    f"--mesh: expected '<axis>=<int>' pairs, got {part!r} "
+                    f"(e.g. 'data=4,model=2')"
+                )
+            kwargs[k] = int(v)
+        return MeshSpec(**kwargs)
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named device mesh; TPU-topology-aware when possible.
+
+    With the default spec this produces a 1-D ``data`` mesh over all devices —
+    the TPU-native equivalent of the reference's DDP world (train_ddp.py:65).
+    """
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    sizes = spec.resolved(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except (ValueError, AssertionError, NotImplementedError):
+        # Non-TPU backends (CPU test meshes) or odd shapes: plain reshape.
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def batch_shard_count(mesh: Mesh) -> int:
+    """Number of ways the global batch is split (product of batch axes)."""
+    return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))
+
+
+def local_batch_size(per_device_batch: int, mesh: Mesh) -> int:
+    """This host's share of the global batch.
+
+    Preserves the reference's per-device batch semantic (train_ddp.py:27
+    "mini-batch size *per GPU*"): global batch = per_device_batch x
+    (#devices on batch axes); each host feeds its local slice.
+    """
+    local_devices = [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+    num, den = per_device_batch * len(local_devices) * batch_shard_count(mesh), mesh.size
+    if num % den:
+        raise ValueError(
+            f"batch shards ({batch_shard_count(mesh)}) do not divide evenly "
+            f"across this host's {len(local_devices)} of {mesh.size} devices "
+            f"at per-device batch {per_device_batch}"
+        )
+    return num // den

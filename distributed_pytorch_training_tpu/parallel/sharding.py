@@ -1,0 +1,125 @@
+"""Sharding rules: param-path regex -> PartitionSpec.
+
+The DDP wrapper (/root/reference/train_ddp.py:303-311) has exactly one layout:
+every parameter replicated on every device. Here layout is first-class: each
+model ships `PartitionRules` — an ordered list of (path-regex, PartitionSpec)
+— and `shard_pytree` places params/optimizer state on the mesh accordingly.
+Pure DP reproduces DDP (all params replicated); TP/FSDP are just different
+rule tables over the same machinery (SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import BATCH_AXES
+
+
+class PartitionRules:
+    """Ordered (regex, PartitionSpec) table; first match on the '/'-joined
+    param path wins; no match -> fully replicated (the DDP default layout)."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = ()):  # noqa: D401
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, ndim: Optional[int] = None) -> P:
+        for pat, spec in self._rules:
+            if pat.search(path):
+                if ndim is not None and len(spec) > ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern!r} spec {spec} has more axes than "
+                        f"param {path!r} with ndim={ndim}"
+                    )
+                return spec
+        return P()  # replicated
+
+    def __add__(self, other: "PartitionRules") -> "PartitionRules":
+        out = PartitionRules()
+        out._rules = self._rules + other._rules
+        return out
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for_path(rules: Optional[PartitionRules], path: str, ndim: int) -> P:
+    if rules is None:
+        return P()
+    return rules.spec_for(path, ndim)
+
+
+def tree_specs(tree: Any, rules: Optional[PartitionRules]) -> Any:
+    """PartitionSpec pytree matching `tree` (for jit in_shardings / orbax)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for_path(rules, _path_str(path), np.ndim(leaf)),
+        tree,
+    )
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: Optional[PartitionRules] = None) -> Any:
+    """Place a pytree on the mesh per the rules (replicated by default).
+
+    This is the moment DDP performs its rank0->all param broadcast
+    (train_ddp.py:305-310); here placement and layout are one operation.
+    """
+    specs = tree_specs(tree, rules)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_spec(ndim: int = 1) -> P:
+    """Leading dim sharded over the batch axes (data, fsdp); rest replicated.
+
+    This single annotation replaces DistributedSampler + DDP: the global batch
+    is one array split over the mesh (ref :122-127 does this with per-rank
+    index slicing; here it is a layout fact XLA reasons about). Scalars
+    (ndim=0) have no batch dimension and are replicated.
+    """
+    if ndim == 0:
+        return P()
+    return P(BATCH_AXES, *([None] * (ndim - 1)))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(ndim))
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Make each process-local batch shard into one global device array.
+
+    Single-host: a plain device_put with the batch sharding. Multi-host: each
+    process contributes its local slice (the generalization of the reference's
+    per-rank DistributedSampler shard, train_ddp.py:122-127) via
+    `make_array_from_process_local_data`.
+    """
+    def _one(x):
+        x = np.asarray(x)
+        sharding = batch_sharding(mesh, x.ndim)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sharding)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(_one, batch)

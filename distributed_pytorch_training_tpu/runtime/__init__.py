@@ -1,0 +1,8 @@
+"""Process/device runtime — TPU-native equivalent of the reference's L0 layer."""
+
+from .dist import (  # noqa: F401
+    DistContext,
+    cleanup_distributed,
+    is_distributed,
+    setup_distributed,
+)

@@ -1,0 +1,37 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+This is the TPU-world "fake backend" the reference lacks (SURVEY.md §4): real
+collectives on 8 XLA CPU devices, no cluster needed. Must run before jax is
+imported anywhere, hence the env mutation at module import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image pre-sets JAX_PLATFORMS=axon (real TPU)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's sitecustomize imports jax before this conftest runs, so jax's
+# config has already captured JAX_PLATFORMS=axon — override via the config API.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(data=8), devices=devices)

@@ -1,0 +1,111 @@
+"""Collectives tests on the 8-device CPU mesh.
+
+Covers the parity surface for the reference's reduce_tensor/barrier usage
+(/root/reference/train_ddp.py:159-167, :112) plus the ring/all-to-all
+primitives the long-context path needs (SURVEY.md §5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from distributed_pytorch_training_tpu.parallel import (
+    MeshSpec,
+    build_mesh,
+    collectives as cc,
+)
+from distributed_pytorch_training_tpu.parallel.mesh import DATA, SEQ
+
+
+def test_psum_matches_sum(mesh8):
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return cc.psum(jnp.sum(x), DATA, mesh=mesh8)
+
+    out = shard_map(body, mesh=mesh8, in_specs=P(DATA), out_specs=P())(x)
+    assert float(out) == float(x.sum())
+
+
+def test_psum_passthrough_on_trivial_axis(mesh8):
+    # On a mesh where the axis has size 1, psum must be the identity at trace
+    # time (the reference's single-process passthrough, train_ddp.py:164-165).
+    x = jnp.float32(3.5)
+    out = cc.psum(x, "model", mesh=mesh8)  # model axis size 1
+    assert out is x
+
+
+def test_pmean(mesh8):
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return cc.pmean(jnp.sum(x), DATA, mesh=mesh8)
+
+    out = shard_map(body, mesh=mesh8, in_specs=P(DATA), out_specs=P())(x)
+    np.testing.assert_allclose(float(out), float(x.mean()), rtol=1e-6)
+
+
+def test_ppermute_ring_rotates(devices):
+    mesh = build_mesh(MeshSpec(data=1, seq=8), devices=devices)
+    x = jnp.arange(8.0)
+
+    def body(x):
+        return cc.ppermute_ring(x, SEQ, shift=1)
+
+    out = shard_map(body, mesh=mesh, in_specs=P(SEQ), out_specs=P(SEQ))(x)
+    # shift=1 sends shard i to i+1, so position i holds the value from i-1.
+    np.testing.assert_array_equal(np.asarray(out), np.roll(np.arange(8.0), 1))
+
+
+def test_all_to_all_transposes_shards(devices):
+    mesh = build_mesh(MeshSpec(data=1, seq=8), devices=devices)
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(x):  # x: (1, 8) per device
+        return cc.all_to_all(x, SEQ, split_axis=1, concat_axis=0)
+
+    out = shard_map(body, mesh=mesh, in_specs=P(SEQ, None), out_specs=P(None, SEQ))(x)
+    # tiled all_to_all of row-shards into column-shards is a global identity:
+    # the real check is that the per-device shard shape flipped (1,8)->(8,1)
+    # and the values landed back in place.
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert out.addressable_shards[0].data.shape == (8, 1)
+
+
+def test_host_collectives_single_process():
+    # Single-process passthroughs (jax.process_count()==1 in tests).
+    cc.barrier()  # no-op, must not hang
+    assert cc.broadcast_from_main({"a": 1})["a"] == 1
+    assert cc.reduce_scalar(4.25) == 4.25
+    assert cc.reduce_scalar(jnp.float32(2.0), op="max") == 2.0
+    gathered = cc.host_all_gather(np.float32(7.0))
+    assert np.asarray(gathered).shape[0] == 1
+
+
+def test_gradient_sync_emerges_from_sharding(mesh8):
+    """The DDP-reducer-equivalence test: a jitted loss over a data-sharded
+    batch yields gradients identical to single-device full-batch gradients —
+    gradient sync with no explicit collective (SURVEY.md §2b row 2)."""
+    from distributed_pytorch_training_tpu.parallel import shard_batch
+
+    w = jnp.ones((4,)) * 0.5
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16).astype(np.float32)
+
+    def loss(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    g_single = jax.grad(loss)(w, x, y)
+
+    batch = shard_batch({"x": x, "y": y}, mesh8)
+    g_mesh = jax.jit(jax.grad(loss))(w, batch["x"], batch["y"])
+    np.testing.assert_allclose(np.asarray(g_mesh), np.asarray(g_single), rtol=1e-5)
+
+
+def test_unknown_axis_raises(mesh8):
+    import pytest
+
+    with pytest.raises(KeyError, match="dtaa"):
+        cc.psum(jnp.float32(1.0), "dtaa", mesh=mesh8)

@@ -1,0 +1,68 @@
+"""Mesh construction tests (SURVEY.md §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+from distributed_pytorch_training_tpu.parallel.mesh import (
+    DATA,
+    MODEL,
+    SEQ,
+    batch_shard_count,
+    local_batch_size,
+)
+
+
+def test_default_spec_is_pure_dp(devices):
+    mesh = build_mesh(devices=devices)
+    assert mesh.shape[DATA] == 8
+    assert all(v == 1 for k, v in mesh.shape.items() if k != DATA)
+
+
+def test_wildcard_fills_remaining(devices):
+    mesh = build_mesh(MeshSpec(data=-1, model=2), devices=devices)
+    assert mesh.shape[DATA] == 4
+    assert mesh.shape[MODEL] == 2
+
+
+def test_3d_mesh(devices):
+    mesh = build_mesh(MeshSpec(data=2, model=2, seq=2), devices=devices)
+    assert mesh.shape[DATA] == 2
+    assert mesh.shape[MODEL] == 2
+    assert mesh.shape[SEQ] == 2
+    assert mesh.size == 8
+
+
+def test_bad_shapes_raise(devices):
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec(data=3), devices=devices)  # 3 does not divide 8
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, model=-1).resolved(8)  # two wildcards
+
+
+def test_mesh_spec_parse():
+    spec = MeshSpec.parse("data=4,model=2")
+    assert spec.data == 4 and spec.model == 2 and spec.seq == 1
+
+
+def test_batch_shard_count_and_local_batch(devices):
+    mesh = build_mesh(MeshSpec(data=4, model=2), devices=devices)
+    assert batch_shard_count(mesh) == 4
+    # per-device batch 16 (ref train_ddp.py:27 semantic), single host:
+    # local batch == global batch == 16 * 4 data-shards.
+    assert local_batch_size(16, mesh) == 64
+
+
+def test_all_devices_used_once(devices):
+    mesh = build_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    ids = sorted(d.id for d in np.asarray(mesh.devices).flat)
+    assert ids == sorted(d.id for d in devices)
+
+
+def test_mesh_spec_parse_errors():
+    with pytest.raises(ValueError, match="unknown axis"):
+        MeshSpec.parse("bogus=2")
+    with pytest.raises(ValueError, match="expected"):
+        MeshSpec.parse("data")
+    with pytest.raises(ValueError, match="expected"):
+        MeshSpec.parse("data=x")
