@@ -1,0 +1,23 @@
+"""Input pipeline — TPU-native equivalent of the reference's L2 layer
+(/root/reference/train_ddp.py:81-150: torchvision CIFAR-10 + transforms +
+DistributedSampler + DataLoader workers).
+
+Design: the host side stays cheap (uint8 arrays, index shuffling, thread
+prefetch); normalization and augmentation run **on device inside the jitted
+step** where they fuse into the forward pass — the TPU answer to torchvision
+transform pipelines and `pin_memory` H2D overlap (ref :131-148).
+"""
+
+from .datasets import (  # noqa: F401
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ArrayDataset,
+    get_dataset,
+    load_cifar10,
+    synthetic_image_dataset,
+)
+from .augment import normalize_images, random_crop_flip  # noqa: F401
+from .loader import ShardedLoader  # noqa: F401
+from .sampler import ShardedSampler  # noqa: F401

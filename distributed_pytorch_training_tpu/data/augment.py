@@ -1,0 +1,62 @@
+"""Device-side augmentation — the transform pipeline, jit-fused.
+
+Reference transforms (/root/reference/train_ddp.py:91-101): RandomCrop(32,
+padding=4) + RandomHorizontalFlip + ToTensor + Normalize for train; ToTensor +
+Normalize for eval. torchvision runs these per-sample in DataLoader worker
+processes on the host; here they are vectorized jax ops executed on the TPU as
+part of the compiled step, where XLA fuses them into the input side of the
+forward pass (no host CPU augmentation bottleneck, no extra H2D traffic —
+uint8 crosses the wire, float math happens on device).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_images(
+    images: jnp.ndarray,
+    mean: Sequence[float],
+    std: Sequence[float],
+    dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """uint8 NHWC -> normalized float (ToTensor + Normalize, ref :94-95,
+    :86-89). `dtype` is the compute dtype (bf16 under mixed precision)."""
+    x = images.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(mean, jnp.float32).reshape(1, 1, 1, -1)
+    std = jnp.asarray(std, jnp.float32).reshape(1, 1, 1, -1)
+    return ((x - mean) / std).astype(dtype)
+
+
+def random_crop_flip(
+    images: jnp.ndarray,
+    key: jax.Array,
+    padding: int = 4,
+    flip_prob: float = 0.5,
+) -> jnp.ndarray:
+    """RandomCrop(H, padding) + RandomHorizontalFlip, vectorized over the
+    batch (ref :92-93). Input NHWC (any numeric dtype); output same shape.
+
+    Implementation notes for XLA: per-sample crop offsets become one
+    `dynamic_slice` per sample under `vmap` — static output shapes, fully
+    fusable, no data-dependent control flow.
+    """
+    n, h, w, c = images.shape
+    key_crop_h, key_crop_w, key_flip = jax.random.split(key, 3)
+    padded = jnp.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="constant",
+    )
+    off_h = jax.random.randint(key_crop_h, (n,), 0, 2 * padding + 1)
+    off_w = jax.random.randint(key_crop_w, (n,), 0, 2 * padding + 1)
+
+    def crop_one(img, oh, ow):
+        return jax.lax.dynamic_slice(img, (oh, ow, 0), (h, w, c))
+
+    cropped = jax.vmap(crop_one)(padded, off_h, off_w)
+    flip = jax.random.bernoulli(key_flip, flip_prob, (n, 1, 1, 1))
+    return jnp.where(flip, cropped[:, :, ::-1, :], cropped)

@@ -1,0 +1,137 @@
+"""Datasets: CIFAR-10 from disk, synthetic generators for every config.
+
+The reference downloads CIFAR-10 via torchvision with a rank-0-only download
+plus barrier (/root/reference/train_ddp.py:103-112). This environment has no
+network egress, so the TPU pipeline reads the standard CIFAR-10 python-pickle
+layout from disk when present and otherwise generates a deterministic
+synthetic stand-in with identical shapes/dtypes — which is also what the
+ImageNet-scale benchmark configs (BASELINE.json:8-10) use, since ImageNet
+cannot ship with a repo either.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import tarfile
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+# Reference normalization constants (train_ddp.py:86-89).
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR10_STD = (0.2470, 0.2435, 0.2616)
+# Standard ImageNet stats (torchvision defaults the reference would use for
+# the ResNet-50/ViT configs, BASELINE.json:9-10).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+@dataclasses.dataclass
+class ArrayDataset:
+    """In-memory dataset: images NHWC uint8, integer labels."""
+
+    images: np.ndarray  # (N, H, W, C) uint8
+    labels: np.ndarray  # (N,) int32
+    num_classes: int
+    name: str = "dataset"
+    synthetic: bool = False
+
+    def __post_init__(self):
+        assert self.images.ndim == 4 and self.images.dtype == np.uint8
+        assert len(self.images) == len(self.labels)
+        self.labels = self.labels.astype(np.int32)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+
+def _cifar_batches_dir(data_dir: Path) -> Optional[Path]:
+    for cand in (data_dir / "cifar-10-batches-py", data_dir):
+        if (cand / "data_batch_1").exists():
+            return cand
+    tar = data_dir / "cifar-10-python.tar.gz"
+    if tar.exists():
+        with tarfile.open(tar) as tf:
+            tf.extractall(data_dir)
+        cand = data_dir / "cifar-10-batches-py"
+        if (cand / "data_batch_1").exists():
+            return cand
+    return None
+
+
+def load_cifar10(data_dir: str, train: bool) -> Optional[ArrayDataset]:
+    """Read the standard CIFAR-10 python pickle layout (what torchvision's
+    download produces, ref :103-108). Returns None if absent on disk."""
+    root = _cifar_batches_dir(Path(data_dir))
+    if root is None:
+        return None
+    files = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    xs, ys = [], []
+    for fname in files:
+        with open(root / fname, "rb") as f:
+            entry = pickle.load(f, encoding="latin1")
+        xs.append(np.asarray(entry["data"], np.uint8))
+        ys.append(np.asarray(entry.get("labels", entry.get("fine_labels")), np.int32))
+    images = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    return ArrayDataset(images, np.concatenate(ys), num_classes=10,
+                        name="cifar10", synthetic=False)
+
+
+def synthetic_image_dataset(
+    n: int,
+    hw: Tuple[int, int] = (32, 32),
+    num_classes: int = 10,
+    seed: int = 0,
+    name: str = "synthetic",
+) -> ArrayDataset:
+    """Deterministic synthetic image classification data.
+
+    Class-conditional means keep the learning problem non-trivial, so
+    integration tests can assert decreasing loss (SURVEY.md §4).
+    """
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=n).astype(np.int32)
+    # Class-conditional means come from a FIXED seed so train and val splits
+    # (different `seed`s) describe the same classification problem; only
+    # labels/noise vary per split.
+    class_means = np.random.RandomState(1234).randint(
+        40, 216, size=(num_classes, 1, 1, 3))
+    noise = rng.randint(-40, 40, size=(n, *hw, 3))
+    images = np.clip(class_means[labels] + noise, 0, 255).astype(np.uint8)
+    return ArrayDataset(images, labels, num_classes=num_classes,
+                        name=name, synthetic=True)
+
+
+_SYNTH_SIZES = {  # (train_n, eval_n) kept CPU-friendly; benches override
+    "cifar10": (50_000, 10_000),
+    "imagenet": (10_000, 1_000),
+}
+
+
+def get_dataset(
+    name: str,
+    data_dir: str = "./data",
+    train: bool = True,
+    synthetic: bool = False,
+    synthetic_size: Optional[int] = None,
+    seed: int = 0,
+) -> ArrayDataset:
+    """Dataset factory (maps get_dataloaders' dataset construction, ref
+    :103-119). Falls back to synthetic data when the real set is absent
+    (zero-egress environments) — loudly, via the `.synthetic` flag."""
+    name = name.lower()
+    if name == "cifar10":
+        if not synthetic:
+            ds = load_cifar10(data_dir, train)
+            if ds is not None:
+                return ds
+        n = synthetic_size or _SYNTH_SIZES["cifar10"][0 if train else 1]
+        return synthetic_image_dataset(n, (32, 32), 10, seed=seed + (0 if train else 1),
+                                       name="cifar10-synthetic")
+    if name == "imagenet":
+        n = synthetic_size or _SYNTH_SIZES["imagenet"][0 if train else 1]
+        return synthetic_image_dataset(n, (224, 224), 1000, seed=seed + (0 if train else 1),
+                                       name="imagenet-synthetic")
+    raise ValueError(f"unknown dataset {name!r} (cifar10, imagenet)")

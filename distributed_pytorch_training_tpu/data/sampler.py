@@ -1,0 +1,88 @@
+"""Sharded sampling: the DistributedSampler contract, jit-shaped.
+
+Reference semantics (/root/reference/train_ddp.py:121-139):
+* `DistributedSampler(shuffle=True)` — a global permutation seeded by
+  `seed + epoch` (`set_epoch`, ref :185), partitioned across ranks.
+* `drop_last=False` (ref :139) — the last incomplete batch still trains.
+
+The TPU twist: jit wants static shapes, so a short last batch would trigger
+recompilation. Instead the permutation is padded up to a whole number of
+global batches and a per-sample weight array marks padding with 0 (SURVEY.md
+§7 "hard parts (a)"). Loss and metrics are weight-aware, so they match the
+variable-batch semantics exactly. Padding slots hold *wrap-around repeats of
+the shuffled permutation* (the same trick torch's DistributedSampler uses to
+even out ranks), so batch-statistic layers (BatchNorm) see real, varied
+samples — only the loss/metric contribution of the repeats is masked out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ShardedSampler:
+    """Deterministic epoch sharding of `n` samples into fixed-size global
+    batches, sliced per process.
+
+    Parameters mirror the reference: `global_batch` = per-device batch x
+    batch-shard count (ref :27 per-GPU semantic), `shuffle` + `seed` feed the
+    per-epoch permutation (ref :122-127, :185), `drop_last` (ref :139).
+    `process_index`/`process_count` generalize `rank`/`num_replicas`.
+    """
+
+    n: int
+    global_batch: int
+    shuffle: bool = True
+    seed: int = 42
+    drop_last: bool = False
+    process_index: int = 0
+    process_count: int = 1
+
+    def __post_init__(self):
+        if self.global_batch % self.process_count:
+            raise ValueError(
+                f"global batch {self.global_batch} not divisible by "
+                f"{self.process_count} processes"
+            )
+        self.local_batch = self.global_batch // self.process_count
+
+    def steps_per_epoch(self) -> int:
+        if self.drop_last:
+            return self.n // self.global_batch
+        return -(-self.n // self.global_batch)  # ceil
+
+    def epoch_indices(self, epoch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(indices, weights) for this process, shaped
+        (steps, local_batch); weights are 0.0 on padding slots.
+
+        The permutation is identical on every process (same seed+epoch rule
+        as `set_epoch`, ref :185) so shards are disjoint and exhaustive.
+        """
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + epoch).permutation(self.n)
+        else:
+            order = np.arange(self.n)
+        steps = self.steps_per_epoch()
+        usable = steps * self.global_batch
+        if self.drop_last:
+            order = order[:usable]
+            weights = np.ones(usable, np.float32)
+        else:
+            pad = usable - self.n
+            weights = np.concatenate([np.ones(self.n, np.float32),
+                                      np.zeros(pad, np.float32)])
+            # wrap-around padding with real samples (DistributedSampler-style)
+            reps = np.resize(order, pad) if pad else order[:0]
+            order = np.concatenate([order, reps])
+        order = order.reshape(steps, self.process_count, self.local_batch)
+        weights = weights.reshape(steps, self.process_count, self.local_batch)
+        return order[:, self.process_index], weights[:, self.process_index]
+
+    def iter_epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        idx, w = self.epoch_indices(epoch)
+        for step in range(idx.shape[0]):
+            yield idx[step], w[step]

@@ -1,0 +1,127 @@
+"""Text/LM data pipeline — token datasets for the BERT-MLM and GPT-2 configs
+(BASELINE.json:11-12). No analogue in the reference (vision-only); this is
+the text-side counterpart of datasets.py.
+
+Zero-egress: corpora are synthetic token streams with Zipfian unigram
+statistics (so losses have realistic scale) or token arrays loaded from disk
+(.npy / .bin of uint16/uint32 token ids — the standard packed-LM layout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel.mesh import batch_shard_count
+from ..parallel.sharding import shard_batch
+from .sampler import ShardedSampler
+
+
+@dataclasses.dataclass
+class TokenDataset:
+    """Packed token ids (N, seq_len) int32, already chunked to sequences."""
+
+    tokens: np.ndarray  # (N, S) int32
+    vocab_size: int
+    name: str = "tokens"
+    synthetic: bool = False
+
+    def __post_init__(self):
+        assert self.tokens.ndim == 2
+        self.tokens = self.tokens.astype(np.int32)
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def seq_len(self) -> int:
+        return self.tokens.shape[1]
+
+
+def synthetic_token_dataset(
+    n: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    name: str = "synthetic-tokens",
+) -> TokenDataset:
+    """Zipfian token sequences — deterministic, loss-scale-realistic."""
+    rng = np.random.RandomState(seed)
+    # Zipf over the vocab (clipped to vocab_size); ids shuffled so frequent
+    # tokens are spread over the id space like a real BPE vocab.
+    raw = rng.zipf(1.3, size=(n, seq_len))
+    ids = np.minimum(raw, vocab_size) - 1
+    perm = np.random.RandomState(1234).permutation(vocab_size)
+    return TokenDataset(perm[ids], vocab_size, name=name, synthetic=True)
+
+
+def load_token_file(path: str, seq_len: int, vocab_size: int) -> TokenDataset:
+    """Load a packed token file (.npy or flat binary of uint16/uint32) and
+    chunk into (N, seq_len)."""
+    p = Path(path)
+    if p.suffix == ".npy":
+        flat = np.load(p).ravel()
+    else:
+        flat = np.fromfile(p, dtype=np.uint16).astype(np.int64)
+    n = len(flat) // seq_len
+    return TokenDataset(flat[: n * seq_len].reshape(n, seq_len).astype(np.int32),
+                        vocab_size, name=p.stem, synthetic=False)
+
+
+def get_token_dataset(
+    name: str,
+    seq_len: int,
+    data_dir: str = "./data",
+    train: bool = True,
+    synthetic_size: Optional[int] = None,
+    seed: int = 0,
+) -> TokenDataset:
+    """Factory keyed by config name: 'bert' (vocab 30522), 'gpt2' (50257)."""
+    vocabs = {"bert": 30522, "gpt2": 50257}
+    if name not in vocabs:
+        raise ValueError(f"unknown text dataset {name!r} ({sorted(vocabs)})")
+    vocab = vocabs[name]
+    fname = Path(data_dir) / f"{name}_{'train' if train else 'val'}.npy"
+    if fname.exists():
+        return load_token_file(str(fname), seq_len, vocab)
+    n = synthetic_size or (4096 if train else 512)
+    return TokenDataset(
+        synthetic_token_dataset(n, seq_len, vocab,
+                                seed=seed + (0 if train else 1)).tokens,
+        vocab, name=f"{name}-synthetic", synthetic=True)
+
+
+class TokenLoader:
+    """Mesh-sharded LM batches: {"input_ids": (B, S) int32, "weight": (B,)}.
+
+    Same sharding/padding semantics as data.loader.ShardedLoader; token
+    masking (MLM) and next-token shifting are device-side task concerns
+    (training/tasks.py), not loader concerns.
+    """
+
+    def __init__(self, dataset: TokenDataset, mesh: Mesh,
+                 per_device_batch: int, shuffle: bool, seed: int = 42,
+                 drop_last: bool = False):
+        self.dataset = dataset
+        self.mesh = mesh
+        self.global_batch = per_device_batch * batch_shard_count(mesh)
+        self.sampler = ShardedSampler(
+            n=len(dataset), global_batch=self.global_batch, shuffle=shuffle,
+            seed=seed, drop_last=drop_last,
+            process_index=jax.process_index(),
+            process_count=jax.process_count())
+
+    def __len__(self) -> int:
+        return self.sampler.steps_per_epoch()
+
+    def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+        for idx, w in self.sampler.iter_epoch(epoch):
+            yield shard_batch({
+                "input_ids": self.dataset.tokens[idx],
+                "weight": w,
+            }, self.mesh)

@@ -1,0 +1,125 @@
+"""BERT-base for masked-LM — the "BERT-base MLM seq-len 512 (grad-sync
+profiling run)" config (BASELINE.json:11).
+
+HuggingFace-equivalent architecture (what the reference's dependency stack
+would provide): token + position + type embeddings with post-embedding LN,
+12 post-LN encoder blocks (768 wide, 12 heads, MLP 3072, GELU), and the MLM
+head (dense 768 + GELU + LN, decoder tied to the token embedding + vocab
+bias). Parity anchor: HF ``BertForMaskedLM(bert-base-uncased)`` totals
+109,514,298 trainable params incl. the tied embedding counted once — checked
+in tests/test_models.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.sharding import PartitionRules
+from .layers import (
+    MlpBlock,
+    MultiHeadAttention,
+    dot_product_attention,
+    padding_mask,
+    tp_rules,
+)
+from .registry import register_model
+
+
+class BertBlock(nn.Module):
+    """Post-LN encoder block (BERT ordering: sublayer -> residual -> LN)."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    layernorm_epsilon: float = 1e-12
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        ln = functools.partial(nn.LayerNorm, epsilon=self.layernorm_epsilon,
+                               dtype=self.dtype, param_dtype=self.param_dtype)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
+            name="attn")(x, mask=mask, deterministic=deterministic)
+        x = ln(name="ln1")(x + y)
+        y = MlpBlock(hidden_dim=self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     dropout_rate=self.dropout_rate, name="mlp",
+                     )(x, deterministic=deterministic)
+        return ln(name="ln2")(x + y)
+
+
+class BertForMaskedLM(nn.Module):
+    vocab_size: int = 30522
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-12
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = False):
+        b, s = input_ids.shape
+        tok = nn.Embed(self.vocab_size, self.hidden_dim,
+                       dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="token_embedding")
+        x = tok(input_ids)
+        pos_ids = jnp.arange(s)[None, :]
+        x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype,
+                         name="position_embedding")(pos_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + nn.Embed(self.type_vocab_size, self.hidden_dim,
+                         dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="type_embedding")(token_type_ids)
+        x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="embed_ln")(x)
+
+        mask = padding_mask(attention_mask) if attention_mask is not None else None
+        for i in range(self.depth):
+            x = BertBlock(num_heads=self.num_heads,
+                          head_dim=self.hidden_dim // self.num_heads,
+                          mlp_dim=self.mlp_dim, dtype=self.dtype,
+                          param_dtype=self.param_dtype,
+                          dropout_rate=self.dropout_rate,
+                          layernorm_epsilon=self.layernorm_epsilon,
+                          attention_fn=self.attention_fn,
+                          name=f"block{i}")(x, mask=mask,
+                                            deterministic=not train)
+
+        # MLM head: transform + decode with tied embedding (HF equivalence).
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlm_dense")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlm_ln")(h)
+        logits = tok.attend(h)  # tied decoder: (B, S, vocab)
+        bias = self.param("mlm_bias", nn.initializers.zeros,
+                          (self.vocab_size,), self.param_dtype)
+        return (logits + bias).astype(jnp.float32)
+
+    @staticmethod
+    def partition_rules() -> PartitionRules:
+        return tp_rules()
+
+
+@register_model("bert_base")
+def bert_base(**kw) -> BertForMaskedLM:
+    return BertForMaskedLM(**kw)

@@ -1,0 +1,101 @@
+"""GPT-2 355M (medium) — the "GPT-2 355M multi-host v4-32 pod (scaling
+experiment)" flagship config (BASELINE.json:12).
+
+HF-equivalent architecture: learned token + position embeddings, 24 pre-LN
+blocks (1024 wide, 16 heads, MLP 4096, GELU), final LN, LM head tied to the
+token embedding. Parity anchor: HF ``GPT2LMHeadModel(gpt2-medium)`` has
+354,823,168 params — checked in tests/test_models.py.
+
+Long-context: the attention implementation is pluggable; pass
+``ops.ring_attention.make_ring_attention(mesh)`` to shard the sequence over
+the mesh ``seq`` axis (context parallelism, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.sharding import PartitionRules
+from .layers import (
+    TransformerBlock,
+    causal_mask,
+    dot_product_attention,
+    tp_rules,
+)
+from .registry import register_model
+
+
+class GPT2LMHead(nn.Module):
+    vocab_size: int = 50257
+    hidden_dim: int = 1024
+    depth: int = 24
+    num_heads: int = 16
+    max_position: int = 1024
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-5
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, train: bool = False):
+        b, s = input_ids.shape
+        wte = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       embedding_init=nn.initializers.normal(stddev=0.02),
+                       name="wte")
+        x = wte(input_ids)
+        pos_ids = jnp.arange(s)[None, :]
+        x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype,
+                         embedding_init=nn.initializers.normal(stddev=0.01),
+                         name="wpe")(pos_ids)
+
+        # Kernel attention paths (flash/ring) own the causal structure and
+        # reject explicit masks; the XLA einsum path takes a mask array.
+        uses_kernel = self.attention_fn is not dot_product_attention
+        if uses_kernel:
+            if attention_mask is not None:
+                raise ValueError("flash/ring attention paths do not support "
+                                 "padding masks; use the XLA attention path")
+            mask = None
+        else:
+            mask = causal_mask(s)
+            if attention_mask is not None:
+                mask = mask & attention_mask[:, None, None, :].astype(bool)
+
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.hidden_dim // self.num_heads,
+                mlp_dim=4 * self.hidden_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                dropout_rate=self.dropout_rate,
+                layernorm_epsilon=self.layernorm_epsilon,
+                attention_fn=self.attention_fn,
+                name=f"block{i}",
+            )(x, mask=mask, deterministic=not train)
+
+        x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
+        logits = wte.attend(x)  # tied LM head (HF GPT-2 ties wte <-> lm_head)
+        return logits.astype(jnp.float32)
+
+    @staticmethod
+    def partition_rules() -> PartitionRules:
+        return tp_rules()
+
+
+@register_model("gpt2_355m")
+def gpt2_355m(**kw) -> GPT2LMHead:
+    """GPT-2 medium (355M)."""
+    return GPT2LMHead(hidden_dim=1024, depth=24, num_heads=16, **kw)
+
+
+@register_model("gpt2_124m")
+def gpt2_124m(**kw) -> GPT2LMHead:
+    """GPT-2 small — CPU-testable sibling of the 355M flagship."""
+    return GPT2LMHead(hidden_dim=768, depth=12, num_heads=12, **kw)

@@ -1,0 +1,164 @@
+"""Shared transformer building blocks (attention, MLP, embeddings).
+
+No analogue exists in the reference (ResNet-only, /root/reference/train_ddp.py:154);
+these serve the ViT/BERT/GPT-2 configs (BASELINE.json:9-12) that the
+reference's dependency stack (torchvision/transformers model zoos) would
+provide on GPU.
+
+TP design (megatron-style over the mesh's ``model`` axis, SURVEY.md §2c):
+* qkv projection kernels partitioned on the *output* (head) dim,
+* attention-out and MLP-down kernels partitioned on the *input* dim,
+so each device holds a head/neuron slice and XLA inserts exactly one
+all-reduce per residual join. The rules live in `tp_rules()`.
+
+The attention inner product is pluggable (`attention_fn`) so the Pallas
+flash/ring kernels in `ops/` can replace the XLA einsum path per-config.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import MODEL
+from ..parallel.sharding import PartitionRules
+from jax.sharding import PartitionSpec as P
+
+Dtype = Any
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # (B, S, H, D)
+    k: jnp.ndarray,  # (B, T, H, D)
+    v: jnp.ndarray,  # (B, T, H, D)
+    mask: Optional[jnp.ndarray] = None,  # broadcastable to (B, H, S, T), True=attend
+    dtype: Dtype = jnp.float32,
+) -> jnp.ndarray:
+    """Reference XLA attention: softmax(QK^T/sqrt(d))V. Softmax in fp32 for
+    bf16 stability; output cast back to `dtype`."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(d).astype(np.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1).astype(dtype)
+    return jnp.einsum("bhst,bthd->bshd", weights, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention with fused qkv projection.
+
+    `attention_fn(q, k, v, mask, dtype)` defaults to the XLA einsum path;
+    swap in `ops.flash_attention` / `ops.ring_attention` for long context.
+    """
+
+    num_heads: int
+    head_dim: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.0
+    use_bias: bool = True
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        features = self.num_heads * self.head_dim
+        dense = functools.partial(nn.DenseGeneral, dtype=self.dtype,
+                                  param_dtype=self.param_dtype,
+                                  use_bias=self.use_bias)
+        qkv = dense(features=(3, self.num_heads, self.head_dim), name="qkv")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        y = self.attention_fn(q, k, v, mask=mask, dtype=self.dtype)
+        if self.dropout_rate and not deterministic:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=False)
+        out = nn.DenseGeneral(features=x.shape[-1], axis=(-2, -1),
+                              dtype=self.dtype, param_dtype=self.param_dtype,
+                              use_bias=self.use_bias, name="out")(y)
+        return out
+
+
+class MlpBlock(nn.Module):
+    hidden_dim: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.0
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        d = x.shape[-1]
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="fc1")(x)
+        h = self.activation(h)
+        if self.dropout_rate and not deterministic:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=False)
+        out = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                       name="fc2")(h)
+        return out
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN transformer block (ViT/GPT-2 style; BERT overrides to post-LN)."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.0
+    layernorm_epsilon: float = 1e-5
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        ln = functools.partial(nn.LayerNorm, epsilon=self.layernorm_epsilon,
+                               dtype=self.dtype, param_dtype=self.param_dtype)
+        y = ln(name="ln1")(x)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=self.head_dim, dtype=self.dtype,
+            param_dtype=self.param_dtype, dropout_rate=self.dropout_rate,
+            attention_fn=self.attention_fn, name="attn",
+        )(y, mask=mask, deterministic=deterministic)
+        x = x + y
+        y = ln(name="ln2")(x)
+        y = MlpBlock(hidden_dim=self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     dropout_rate=self.dropout_rate, name="mlp",
+                     )(y, deterministic=deterministic)
+        return x + y
+
+
+def causal_mask(seq_len: int) -> jnp.ndarray:
+    """(1, 1, S, S) lower-triangular True=attend mask."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), bool))[None, None]
+
+
+def padding_mask(attention_mask: jnp.ndarray) -> jnp.ndarray:
+    """(B, T) 1=real token -> (B, 1, 1, T) attend mask."""
+    return attention_mask[:, None, None, :].astype(bool)
+
+
+def tp_rules() -> PartitionRules:
+    """Megatron-style tensor-parallel rules shared by every transformer here.
+
+    Matches the param paths produced by the modules above:
+    * `qkv/kernel` (d_model, 3, heads, head_dim): split heads -> axis 2
+    * `out/kernel` (heads, head_dim, d_model): split heads -> axis 0
+    * `mlp/fc1/kernel` (d_model, hidden): split hidden -> axis 1
+    * `mlp/fc2/kernel` (hidden, d_model): split hidden -> axis 0
+    * token embeddings (vocab, d_model): split vocab (megatron) -> axis 0
+    """
+    return PartitionRules([
+        (r"attn/qkv/kernel", P(None, None, MODEL, None)),
+        (r"attn/qkv/bias", P(None, MODEL, None)),
+        (r"attn/out/kernel", P(MODEL, None, None)),
+        (r"mlp/fc1/kernel", P(None, MODEL)),
+        (r"mlp/fc1/bias", P(MODEL)),
+        (r"mlp/fc2/kernel", P(MODEL, None)),
+        (r"(token_embedding|wte)/embedding", P(MODEL, None)),
+    ])

@@ -1,0 +1,78 @@
+"""ViT-B/16 — the "ViT-B/16 / ImageNet bf16 (AMP-path parity)" config
+(BASELINE.json:10). Torchvision-equivalent architecture (what the reference's
+stack would provide): 16x16 conv patch embed, CLS token, learned positional
+embeddings, 12 pre-LN blocks of width 768 / 12 heads / MLP 3072, LN + linear
+head. torchvision vit_b_16(num_classes=1000) has 86,567,656 params — the
+parity check in tests/test_models.py."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.sharding import PartitionRules
+from .layers import TransformerBlock, dot_product_attention, tp_rules
+from .registry import register_model
+
+
+class ViT(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-6
+    attention_fn: Callable = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        n = x.shape[0]
+        x = nn.Conv(self.hidden_dim, (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        x = x.reshape(n, -1, self.hidden_dim)  # (N, S, D)
+
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.hidden_dim), self.param_dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (n, 1, self.hidden_dim)
+                                              ).astype(self.dtype), x], axis=1)
+        pos = self.param("pos_embedding",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.hidden_dim), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads,
+                head_dim=self.hidden_dim // self.num_heads,
+                mlp_dim=self.mlp_dim, dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                dropout_rate=self.dropout_rate,
+                layernorm_epsilon=self.layernorm_epsilon,
+                attention_fn=self.attention_fn,
+                name=f"block{i}",
+            )(x, deterministic=not train)
+
+        x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_final")(x)
+        cls_out = x[:, 0]
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="head")(cls_out)
+        return logits.astype(jnp.float32)
+
+    @staticmethod
+    def partition_rules() -> PartitionRules:
+        return tp_rules()
+
+
+@register_model("vit_b16")
+def vit_b16(num_classes: int = 1000, **kw) -> ViT:
+    return ViT(num_classes=num_classes, **kw)

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+The reference leans on cuDNN/CUDA kernels via torch (SURVEY.md §2b); here the
+XLA compiler covers most fusion, and Pallas supplies the ops XLA does not
+schedule optimally: blockwise (flash) attention and the ring-attention
+context-parallel primitive (SURVEY.md §5 long-context requirement).
+"""
+
+from .flash_attention import flash_attention, make_flash_attention_fn  # noqa: F401
+from .ring_attention import make_ring_attention_fn, ring_attention  # noqa: F401

@@ -1,0 +1,171 @@
+"""Blockwise (flash) attention — Pallas TPU kernel.
+
+Memory-efficient attention: O(S) live memory instead of materializing the
+(S, S) score matrix, via online softmax over K/V blocks held in VMEM. This is
+the long-context building block SURVEY.md §5 requires (the reference has no
+attention at all — ResNet on 32x32 images; the capability enters through the
+BERT-512/GPT-2 configs, BASELINE.json:11-12).
+
+Design (per pallas_guide.md):
+* grid = (batch*heads, Sq/block_q); K/V for one (batch, head) live in VMEM;
+  the kernel fori_loops over K blocks with a running (max, denom, acc) online
+  softmax in fp32; MXU matmuls via jnp.dot(..., preferred_element_type=f32).
+* causal masking skips whole K blocks past the diagonal (loop bound, not a
+  mask), masking only the diagonal block with broadcasted_iota.
+* backward: custom_vjp that recomputes attention with the XLA reference path
+  (rematerialization trades FLOPs for memory, the TPU-idiomatic default);
+  a fully-blockwise backward kernel is a further optimization.
+* on CPU backends (tests, dry-runs) the kernel runs in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+    """XLA einsum attention (the recompute path for the backward pass)."""
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))[None, None]
+        logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", weights, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                causal: bool, sm_scale: float):
+    # q_ref: (1, block_q, d); k_ref/v_ref: (1, Sk, d); o_ref: (1, block_q, d)
+    qb = pl.program_id(1)
+    d = q_ref.shape[-1]
+    sk = k_ref.shape[1]
+    nkb = sk // block_k
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    if causal:
+        # only K blocks intersecting the lower triangle of this Q block
+        upper = jax.lax.min(nkb, pl.cdiv((qb + 1) * block_q, block_k))
+    else:
+        upper = nkb
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
+               block_q: int, block_k: int):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows.
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(
+            f"flash_attention: seq lengths ({sq}, {sk}) must be divisible by "
+            f"block sizes ({block_q}, {block_k})")
+
+    grid = (b * h, sq // block_q)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        interpret=(jax.default_backend() == "cpu"),
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jnp.ndarray,  # (B, S, H, D)
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Blockwise attention; numerically equivalent to softmax(QK^T*scale)V."""
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    q, k, v = residuals
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    # Rematerialize through the XLA reference path (same math, O(S^2) scores
+    # regenerated rather than stored — the jax.checkpoint idiom).
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def make_flash_attention_fn(causal: bool, block_q: int = 128, block_k: int = 128):
+    """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`.
+
+    The mask argument must be None (padding masks need the XLA path); causal
+    structure is handled inside the kernel via block skipping, which is why
+    this is faster than passing a causal mask to the einsum path.
+    """
+
+    def attention_fn(q, k, v, mask=None, dtype=jnp.float32):
+        if mask is not None:
+            raise ValueError(
+                "flash attention path handles causal masking internally; "
+                "explicit masks require the XLA attention path")
+        return flash_attention(q, k, v, causal, None, block_q, block_k
+                               ).astype(dtype)
+
+    return attention_fn

@@ -70,6 +70,10 @@ class MeshSpec:
             SEQ: self.seq,
             MODEL: self.model,
         }
+        bad = {k: v for k, v in sizes.items() if v < 1 and v != -1}
+        if bad:
+            raise ValueError(
+                f"axis sizes must be >= 1 (or -1 for 'all remaining'), got {bad}")
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one -1 axis allowed, got {wild}")
@@ -106,7 +110,13 @@ class MeshSpec:
                     f"--mesh: expected '<axis>=<int>' pairs, got {part!r} "
                     f"(e.g. 'data=4,model=2')"
                 )
-            kwargs[k] = int(v)
+            size = int(v)
+            if size < 1 and size != -1:
+                raise ValueError(
+                    f"--mesh: axis size must be >= 1 (or -1 for 'all "
+                    f"remaining devices'), got {part!r}"
+                )
+            kwargs[k] = size
         return MeshSpec(**kwargs)
 
 

@@ -1,0 +1,9 @@
+"""Training loops, optimizers, state — TPU-native equivalent of the reference's
+L4 layer (/root/reference/train_ddp.py:170-300) plus the optimizer/scaler setup
+(:339-346). The whole per-batch body (ref :198-222) compiles to ONE XLA program
+per step; gradient synchronization is a layout consequence, not code.
+"""
+
+from .optim import make_optimizer, make_schedule  # noqa: F401
+from .train_state import TrainState  # noqa: F401
+from .loop import Trainer, TrainConfig  # noqa: F401

@@ -1,0 +1,67 @@
+"""Checkpoint / resume — absent from the reference (no torch.save/load
+anywhere; SURVEY.md §5 "Checkpoint/resume: Absent") but required for usable
+multi-host training on preemptible TPU pods.
+
+Orbax-backed: sharded async-capable writes, multi-host-safe (every process
+participates; no rank-0 funnel). Only the array pytrees are persisted
+(step/params/batch_stats/opt_state); `apply_fn`/`tx` are code, reconstructed
+by the caller — restoring requires a template TrainState with matching
+structure, which `train.py` always has before resume.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Tuple
+
+import orbax.checkpoint as ocp
+
+from .train_state import TrainState
+
+
+def _arrays(state: TrainState) -> dict:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+class CheckpointManager:
+    """Epoch-granular save/restore-latest (the resume story the reference's
+    append-only CSV hints at but never implements, ref :349-354)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._mgr = ocp.CheckpointManager(
+            Path(directory).resolve(),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, epoch: int, state: TrainState, wait: bool = False) -> None:
+        self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays(state)))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore_latest(self, template: TrainState) -> Optional[Tuple[TrainState, int]]:
+        """Returns (state, epoch) or None if no checkpoint exists. `template`
+        supplies structure/sharding for every restored array."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_arrays(template)))
+        state = template.replace(
+            step=restored["step"],
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=restored["opt_state"],
+        )
+        return state, step
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

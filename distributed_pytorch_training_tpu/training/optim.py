@@ -1,0 +1,97 @@
+"""Optimizers — TPU-native equivalent of ``optim.SGD(lr, momentum,
+weight_decay)`` (/root/reference/train_ddp.py:339-344) plus AdamW for the
+transformer configs (BASELINE.json:11-12).
+
+Built as optax transformation chains with torch-exact semantics:
+torch SGD applies weight decay by adding ``wd * param`` to the gradient
+*before* the momentum buffer update (decoupled-from-loss, coupled-to-momentum)
+— the chain below reproduces that ordering, so parameter trajectories match
+the reference step-for-step in fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import optax
+
+Schedule = Union[float, optax.Schedule]
+
+
+def make_schedule(
+    name: str,
+    base_lr: float,
+    total_steps: Optional[int] = None,
+    warmup_steps: int = 0,
+    final_lr_ratio: float = 0.0,
+) -> optax.Schedule:
+    """LR schedules. The reference uses a constant LR (no scheduler anywhere in
+    train_ddp.py); cosine/warmup are provided for the transformer configs."""
+    if name == "constant":
+        return optax.constant_schedule(base_lr)
+    if name == "cosine":
+        if total_steps is None:
+            raise ValueError("cosine schedule needs total_steps")
+        warm = optax.linear_schedule(0.0, base_lr, max(warmup_steps, 1))
+        cos = optax.cosine_decay_schedule(
+            base_lr, max(total_steps - warmup_steps, 1), alpha=final_lr_ratio)
+        return optax.join_schedules([warm, cos], [warmup_steps])
+    if name == "linear_warmup":
+        warm = optax.linear_schedule(0.0, base_lr, max(warmup_steps, 1))
+        return optax.join_schedules(
+            [warm, optax.constant_schedule(base_lr)], [warmup_steps])
+    raise ValueError(f"unknown schedule {name!r} (constant, cosine, linear_warmup)")
+
+
+def sgd(
+    learning_rate: Schedule,
+    momentum: float = 0.9,
+    weight_decay: float = 5e-4,
+    nesterov: bool = False,
+) -> optax.GradientTransformation:
+    """torch.optim.SGD parity (ref :339-344): g += wd*p, then momentum, then
+    -lr step. Defaults match the reference CLI defaults (ref :30-35)."""
+    parts = []
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    if momentum:
+        parts.append(optax.trace(decay=momentum, nesterov=nesterov))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)
+
+
+def adamw(
+    learning_rate: Schedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+    grad_clip_norm: Optional[float] = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW for BERT/GPT-2 (BASELINE.json:11-12); decoupled weight decay,
+    optional global-norm clipping (standard for LM training)."""
+    parts = []
+    if grad_clip_norm:
+        parts.append(optax.clip_by_global_norm(grad_clip_norm))
+    parts.append(optax.scale_by_adam(b1=b1, b2=b2, eps=eps))
+    if weight_decay:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(optax.scale_by_learning_rate(learning_rate))
+    return optax.chain(*parts)
+
+
+def make_optimizer(
+    name: str,
+    learning_rate: Schedule,
+    momentum: float = 0.9,
+    weight_decay: float = 5e-4,
+    grad_clip_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Optimizer factory keyed by CLI name (the reference hardcodes SGD,
+    ref :339; transformers need AdamW)."""
+    if name == "sgd":
+        return sgd(learning_rate, momentum=momentum, weight_decay=weight_decay)
+    if name == "adamw":
+        return adamw(learning_rate, weight_decay=weight_decay,
+                     grad_clip_norm=grad_clip_norm)
+    raise ValueError(f"unknown optimizer {name!r} (sgd, adamw)")

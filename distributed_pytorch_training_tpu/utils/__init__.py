@@ -1,0 +1,6 @@
+"""Config, logging, metrics, profiling — the reference's L5/L6 layers
+(/root/reference/train_ddp.py:19-46, :224-262, :348-384)."""
+
+from .logging import log_main  # noqa: F401
+from .metrics import MetricsCSV, ThroughputMeter  # noqa: F401
+from .config import parse_args  # noqa: F401

@@ -1,0 +1,85 @@
+"""CLI config — the reference's argparse surface, preserved flag-for-flag
+(/root/reference/train_ddp.py:19-46: same names, same defaults, same
+per-device ``--batch-size`` semantic, ref :27), plus TPU-native extensions
+(model/dataset selection, mesh spec, checkpointing, profiling).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="TPU-native distributed training (parity: DDP training of "
+                    "ResNet-18 on CIFAR-10, ref train_ddp.py:20)")
+
+    # --- reference flags, identical names and defaults (ref :22-44) ---
+    parser.add_argument("--data-dir", default="./data", type=str,
+                        help="directory to store CIFAR-10")
+    parser.add_argument("--epochs", default=10, type=int,
+                        help="number of total epochs to run")
+    parser.add_argument("--batch-size", default=128, type=int,
+                        help="mini-batch size *per device* (ref: per GPU)")
+    parser.add_argument("--workers", default=4, type=int,
+                        help="host-side prefetch depth (ref: DataLoader workers)")
+    parser.add_argument("--lr", default=0.1, type=float,
+                        help="initial learning rate")
+    parser.add_argument("--momentum", default=0.9, type=float,
+                        help="SGD momentum")
+    parser.add_argument("--weight-decay", default=5e-4, type=float,
+                        help="weight decay")
+    parser.add_argument("--amp", "--bf16", dest="amp", action="store_true",
+                        help="mixed precision: bf16 compute, fp32 params "
+                             "(ref --amp; no GradScaler needed on TPU)")
+    parser.add_argument("--print-freq", default=50, type=int,
+                        help="print frequency (in steps)")
+    parser.add_argument("--output-dir", default="./experiments", type=str,
+                        help="directory to save logs")
+    parser.add_argument("--seed", default=42, type=int,
+                        help="random seed")
+
+    # --- TPU-native extensions ---
+    parser.add_argument("--model", default="resnet18", type=str,
+                        help="model name (resnet18/resnet50/vit_b16/bert_base/gpt2_355m)")
+    parser.add_argument("--dataset", default="cifar10", type=str,
+                        help="dataset name (cifar10/imagenet)")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="force synthetic data (zero-egress environments)")
+    parser.add_argument("--synthetic-size", default=None, type=int,
+                        help="synthetic dataset size override")
+    parser.add_argument("--mesh", default="data=-1", type=str,
+                        help="mesh spec, e.g. 'data=4,model=2' (default: pure DP)")
+    parser.add_argument("--optimizer", default="sgd", type=str,
+                        help="sgd | adamw")
+    parser.add_argument("--seq-len", default=None, type=int,
+                        help="sequence length for LM configs (default: 512 "
+                             "for bert_base, 1024 for gpt2)")
+    parser.add_argument("--attention", default="xla", type=str,
+                        choices=["xla", "flash", "ring"],
+                        help="attention implementation for causal LM configs: "
+                             "xla einsum, Pallas flash kernel, or ring "
+                             "(sequence-parallel over the mesh seq axis)")
+    parser.add_argument("--schedule", default="constant", type=str,
+                        help="lr schedule: constant | cosine | linear_warmup")
+    parser.add_argument("--warmup-steps", default=0, type=int)
+    parser.add_argument("--drop-last", action="store_true",
+                        help="drop the final partial batch (ref default: keep it)")
+    parser.add_argument("--no-augment", action="store_true",
+                        help="disable train-time crop/flip augmentation")
+    parser.add_argument("--cifar-stem", action="store_true",
+                        help="3x3/1 ResNet stem for 32x32 inputs (ref uses the "
+                             "unmodified ImageNet stem)")
+    parser.add_argument("--checkpoint-dir", default=None, type=str,
+                        help="enable checkpointing to this directory")
+    parser.add_argument("--checkpoint-every", default=1, type=int,
+                        help="checkpoint every N epochs")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from latest checkpoint in --checkpoint-dir")
+    parser.add_argument("--profile-dir", default=None, type=str,
+                        help="capture a jax.profiler trace into this directory")
+    parser.add_argument("--profile-steps", default="10,20", type=str,
+                        help="start,stop step of the profiled window")
+
+    return parser.parse_args(argv)

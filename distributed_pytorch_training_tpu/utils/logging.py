@@ -1,0 +1,20 @@
+"""Main-process-only logging — the reference's rank-0 print convention
+(/root/reference/train_ddp.py:229, :326-327, :374-379). Single-writer output
+is also the race-avoidance story for log files (SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def log_main(*args, **kwargs) -> None:
+    """print() on process 0 only (ref `if rank == 0: print(...)`)."""
+    if is_main_process():
+        print(*args, **kwargs)
+        sys.stdout.flush()

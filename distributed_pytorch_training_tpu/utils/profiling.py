@@ -1,0 +1,57 @@
+"""Profiling — jax.profiler trace capture.
+
+The reference promises a "Profiling run" and a gradient-sync share-of-step
+analysis but implements neither (/root/reference/README.md:23,:35; SURVEY.md
+§5). Here: a step-windowed `jax.profiler` trace (collective time is read off
+the XLA trace timeline — on TPU the compiler fuses/overlaps the all-reduce,
+so a timer around `.backward()` has no equivalent; trace analysis is the
+correct instrument, BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .logging import log_main
+
+
+class StepProfiler:
+    """Captures a jax.profiler trace for global steps [start, stop).
+
+    Use as the Trainer's `step_hook`: fires `start_trace` when entering step
+    `start` and `stop_trace` when entering step `stop`. Process 0 only (one
+    trace per job; the XLA timeline includes every device it can see).
+    """
+
+    def __init__(self, log_dir: str, start: int, stop: int):
+        if stop <= start:
+            raise ValueError(f"profile window needs stop > start, got {start},{stop}")
+        self.log_dir = log_dir
+        self.start = start
+        self.stop = stop
+        self._active = False
+        self._done = False
+        self._seen = 0
+
+    def __call__(self, step_in_epoch: int) -> None:
+        step = self._seen
+        self._seen += 1
+        if self._done or jax.process_index() != 0:
+            return
+        if not self._active and self.start <= step < self.stop:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and step >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            log_main(f"Profiler trace (steps {self.start}-{self.stop}) "
+                     f"written to {self.log_dir}")
+
+    def close(self) -> None:
+        """Stop the trace if the run ended inside the window."""
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            log_main(f"Profiler trace written to {self.log_dir}")

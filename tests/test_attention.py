@@ -1,0 +1,139 @@
+"""Attention kernel tests: flash (Pallas, interpreter mode on CPU) and ring
+(shard_map over the seq axis) against the XLA reference — values and
+gradients (SURVEY.md §5 long-context requirements)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.models.layers import dot_product_attention
+from distributed_pytorch_training_tpu.ops import (
+    flash_attention,
+    make_flash_attention_fn,
+    make_ring_attention_fn,
+    ring_attention,
+)
+from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+
+
+def _rand_qkv(b=2, s=128, h=4, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (b, s, h, d)
+    q = rng.randn(*shape).astype(np.float32) * 0.5
+    k = rng.randn(*shape).astype(np.float32) * 0.5
+    v = rng.randn(*shape).astype(np.float32) * 0.5
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+def _ref(q, k, v, causal):
+    mask = None
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))[None, None]
+    return dot_product_attention(q, k, v, mask=mask)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _rand_qkv()
+        out = flash_attention(q, k, v, causal, None, 64, 64)
+        expect = _ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_uneven_blocks_raise(self):
+        q, k, v = _rand_qkv(s=100)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(q, k, v, False, None, 64, 64)
+
+    def test_gradients_match_reference(self):
+        q, k, v = _rand_qkv(b=1, s=64, h=2, d=16)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, None, 32, 32) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref(q, k, v, True) ** 2).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_adapter_rejects_mask(self):
+        fn = make_flash_attention_fn(causal=True)
+        q, k, v = _rand_qkv(s=64)
+        with pytest.raises(ValueError, match="mask"):
+            fn(q, k, v, mask=jnp.ones((1, 1, 64, 64), bool))
+
+
+class TestRingAttention:
+    @pytest.fixture(scope="class")
+    def seq_mesh(self, devices):
+        return build_mesh(MeshSpec(data=2, seq=4), devices=devices)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, seq_mesh, causal):
+        q, k, v = _rand_qkv(b=2, s=64, h=2, d=16)
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, seq_mesh, causal=causal))(q, k, v)
+        expect = _ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradients_flow_through_ring(self, seq_mesh):
+        q, k, v = _rand_qkv(b=2, s=32, h=2, d=8)
+
+        def loss_ring(q, k, v):
+            return (ring_attention(q, k, v, seq_mesh, causal=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref(q, k, v, True) ** 2).sum()
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_seq_axis_1_degrades_gracefully(self, devices):
+        # mesh with seq=1: ring of length 1 == plain attention
+        mesh = build_mesh(MeshSpec(data=2), devices=devices[:2])
+        q, k, v = _rand_qkv(b=2, s=32, h=2, d=8)
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=True))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(q, k, v, True)),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestModelKernelIntegration:
+    def test_gpt2_flash_matches_xla(self):
+        from distributed_pytorch_training_tpu.models import get_model
+
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 1000, (2, 64)))
+        m_xla = get_model("gpt2_124m", max_position=64)
+        variables = m_xla.init(jax.random.PRNGKey(0), ids, train=False)
+        out_xla = m_xla.apply(variables, ids, train=False)
+
+        m_flash = get_model("gpt2_124m", max_position=64,
+                            attention_fn=make_flash_attention_fn(
+                                causal=True, block_q=32, block_k=32))
+        out_flash = m_flash.apply(variables, ids, train=False)
+        np.testing.assert_allclose(np.asarray(out_xla), np.asarray(out_flash),
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_gpt2_kernel_path_rejects_padding_mask(self):
+        from distributed_pytorch_training_tpu.models import get_model
+
+        ids = jnp.zeros((1, 32), jnp.int32)
+        m = get_model("gpt2_124m", max_position=32,
+                      attention_fn=make_flash_attention_fn(causal=True,
+                                                           block_q=32,
+                                                           block_k=32))
+        variables = m.init(jax.random.PRNGKey(0), ids, train=False)
+        with pytest.raises(ValueError, match="padding masks"):
+            m.apply(variables, ids, attention_mask=jnp.ones((1, 32)),
+                    train=False)

@@ -1,0 +1,61 @@
+"""End-to-end integration: the CLI contract (SURVEY.md §4: "ResNet-18/CIFAR-10
+CPU-runnable end-to-end asserting the CSV contract (ref :352-354) and
+decreasing loss")."""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.slow
+def test_train_cli_end_to_end(tmp_path, capsys):
+    import train
+
+    out = tmp_path / "exp"
+    train.main([
+        "--epochs", "2", "--synthetic", "--synthetic-size", "512",
+        "--batch-size", "8", "--lr", "0.02", "--print-freq", "4", "--seed", "0",
+        "--output-dir", str(out), "--cifar-stem",
+    ])
+    captured = capsys.readouterr().out
+
+    # stdout contract (ref :326-327, :237-242, :374-379)
+    assert "Using device:" in captured and "world_size=8" in captured
+    assert "Throughput:" in captured and "samples/s (global)" in captured
+    assert "[Epoch 2/2]" in captured
+
+    # CSV contract (ref :349-354)
+    csv_path = out / "metrics_rank0.csv"
+    lines = csv_path.read_text().strip().splitlines()
+    assert lines[0] == "epoch,train_loss,train_acc,val_loss,val_acc,epoch_time_seconds"
+    assert len(lines) == 3
+    rows = [line.split(",") for line in lines[1:]]
+    assert [r[0] for r in rows] == ["1", "2"]
+    # decreasing train loss across epochs
+    assert float(rows[1][1]) < float(rows[0][1])
+
+    # append-only across runs (ref :350): rerun 1 epoch, header not rewritten
+    train.main([
+        "--epochs", "1", "--synthetic", "--synthetic-size", "512",
+        "--batch-size", "8", "--lr", "0.02", "--print-freq", "100", "--seed", "0",
+        "--output-dir", str(out), "--cifar-stem",
+    ])
+    lines2 = csv_path.read_text().strip().splitlines()
+    assert len(lines2) == 4 and lines2[0] == lines[0]
+
+
+@pytest.mark.slow
+def test_train_cli_bf16_and_checkpoint_resume(tmp_path):
+    import train
+
+    out = tmp_path / "exp_bf16"
+    ck = tmp_path / "ckpt"
+    common = [
+        "--synthetic", "--synthetic-size", "128", "--batch-size", "4",
+        "--print-freq", "100", "--seed", "0", "--amp", "--cifar-stem",
+        "--output-dir", str(out), "--checkpoint-dir", str(ck),
+    ]
+    train.main(["--epochs", "1"] + common)
+    # resume continues at epoch 2
+    train.main(["--epochs", "2", "--resume"] + common)
+    lines = (out / "metrics_rank0.csv").read_text().strip().splitlines()
+    assert [line.split(",")[0] for line in lines[1:]] == ["1", "2"]

@@ -66,3 +66,12 @@ def test_mesh_spec_parse_errors():
         MeshSpec.parse("data")
     with pytest.raises(ValueError, match="expected"):
         MeshSpec.parse("data=x")
+
+
+def test_mesh_spec_rejects_zero_and_negative():
+    with pytest.raises(ValueError, match="axis size"):
+        MeshSpec.parse("data=0")
+    with pytest.raises(ValueError, match="axis size"):
+        MeshSpec.parse("data=-3")
+    with pytest.raises(ValueError, match=">= 1"):
+        MeshSpec(data=0).resolved(8)

@@ -1,0 +1,79 @@
+"""Model zoo tests: shapes, param-count parity with torchvision, registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.models import get_model, list_models
+
+
+def _param_count(params):
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+class TestResNet:
+    def test_resnet18_param_count_matches_torchvision(self):
+        """torchvision.models.resnet18(num_classes=10) (ref :154) has
+        11,181,642 parameters — architecture parity check."""
+        model = get_model("resnet18", num_classes=10)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 32, 3)), train=False)
+        # count params + batch_stats the way torch's numel over parameters()
+        # counts (torch excludes BN running stats from parameters())
+        assert _param_count(variables["params"]) == 11_181_642
+
+    def test_resnet50_param_count(self):
+        """torchvision resnet50(num_classes=1000): 25,557,032 params."""
+        model = get_model("resnet50", num_classes=1000)
+        variables = jax.eval_shape(
+            lambda: get_model("resnet50", num_classes=1000).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False))
+        total = sum(int(np.prod(x.shape))
+                    for x in jax.tree_util.tree_leaves(variables["params"]))
+        assert total == 25_557_032
+
+    def test_forward_shapes(self):
+        model = get_model("resnet18", num_classes=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+
+    def test_bf16_compute_fp32_logits(self):
+        model = get_model("resnet18", num_classes=10, dtype=jnp.bfloat16)
+        x = jnp.zeros((2, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        for leaf in jax.tree_util.tree_leaves(variables["params"]):
+            assert leaf.dtype == jnp.float32  # params stored fp32
+        logits = model.apply(variables, x, train=False)
+        assert logits.dtype == jnp.float32  # loss math in fp32
+
+    def test_train_mode_updates_batch_stats(self):
+        model = get_model("resnet18", num_classes=10, cifar_stem=True)
+        x = jnp.ones((4, 16, 16, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        _, mutated = model.apply(variables, x, train=True,
+                                 mutable=["batch_stats"])
+        before = jax.tree_util.tree_leaves(variables["batch_stats"])
+        after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+        assert any(not np.allclose(a, b) for a, b in zip(before, after))
+
+    def test_cifar_stem_changes_spatial_handling(self):
+        # ImageNet stem downsamples 32->8 before stages; cifar stem keeps 32.
+        m_std = get_model("resnet18", num_classes=10)
+        m_cif = get_model("resnet18", num_classes=10, cifar_stem=True)
+        x = jnp.zeros((1, 32, 32, 3))
+        v1 = m_std.init(jax.random.PRNGKey(0), x, train=False)
+        v2 = m_cif.init(jax.random.PRNGKey(0), x, train=False)
+        # both produce valid logits
+        assert m_std.apply(v1, x, train=False).shape == (1, 10)
+        assert m_cif.apply(v2, x, train=False).shape == (1, 10)
+
+
+class TestRegistry:
+    def test_list_and_errors(self):
+        assert "resnet18" in list_models() and "resnet50" in list_models()
+        with pytest.raises(ValueError, match="unknown model"):
+            get_model("resnet99")

@@ -1,0 +1,247 @@
+"""TPU-native distributed training entry point.
+
+The orchestration layer — maps `main()` of the reference
+(/root/reference/train_ddp.py:314-390) onto the TPU-native stack:
+
+    reference                          here
+    ---------                          ----
+    parse_args (:315)                  utils.config.parse_args (same flags)
+    setup_distributed NCCL (:318)      runtime.setup_distributed + build_mesh
+    set_seed(seed+rank) (:319)         PRNGKey(seed); per-sample randomness via
+                                       partitionable RNG on the global batch
+    get_dataloaders (:332)             data.ShardedLoader (pad+mask, prefetch)
+    build_model + DDP wrap (:335-336)  models.get_model + shard_pytree
+    criterion/optimizer/scaler (:338)  training.make_optimizer (no scaler: bf16)
+    epoch loop + CSV (:356-384)        identical stdout/CSV contract
+    cleanup (:386)                     runtime.cleanup_distributed
+
+Run: python train.py --epochs 2 --synthetic        (single chip or CPU)
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+# Allow `python train.py` from anywhere.
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_training_tpu.data import (
+    CIFAR10_MEAN, CIFAR10_STD, IMAGENET_MEAN, IMAGENET_STD,
+    ShardedLoader, get_dataset,
+)
+from distributed_pytorch_training_tpu.models import get_model
+from distributed_pytorch_training_tpu.parallel import MeshSpec, barrier, build_mesh
+from distributed_pytorch_training_tpu.parallel.mesh import batch_shard_count
+from distributed_pytorch_training_tpu.runtime import (
+    cleanup_distributed, setup_distributed,
+)
+from distributed_pytorch_training_tpu.training import (
+    TrainConfig, Trainer, make_optimizer, make_schedule,
+)
+from distributed_pytorch_training_tpu.training.tasks import ImageClassificationTask
+from distributed_pytorch_training_tpu.utils import MetricsCSV, log_main, parse_args
+
+IMAGE_STATS = {
+    "cifar10": (CIFAR10_MEAN, CIFAR10_STD),
+    "imagenet": (IMAGENET_MEAN, IMAGENET_STD),
+}
+
+
+def samples_per_step_list(n: int, global_batch: int, steps: int, drop_last: bool):
+    """Host-known global sample count per step (for the throughput meter,
+    ref :226 counts `batch_size * world_size` per step)."""
+    counts = [global_batch] * steps
+    if not drop_last and steps and n % global_batch:
+        counts[-1] = n % global_batch
+    return counts
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.resume and not args.checkpoint_dir:
+        raise ValueError("--resume requires --checkpoint-dir")
+    Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
+
+    ctx = setup_distributed()  # ref :318
+    mesh = build_mesh(MeshSpec.parse(args.mesh))
+    n_batch_shards = batch_shard_count(mesh)
+    global_batch = args.batch_size * n_batch_shards
+
+    # Banner ≙ ref :326-327 ("Using device: ..., world_size=..., amp=...").
+    dev0 = mesh.devices.flat[0]
+    log_main(
+        f"Using device: {dev0.platform}:{dev0.id} "
+        f"(mesh {dict(mesh.shape)}), world_size={mesh.size}, amp={args.amp}"
+    )
+
+    compute_dtype = jnp.bfloat16 if args.amp else jnp.float32
+    is_lm = args.model.startswith(("gpt2", "bert"))
+
+    # Data (ref :332). Process 0 prepares first (it may extract an archive on
+    # a shared filesystem); others wait at the barrier, then read — the exact
+    # rank-0-download + barrier gating of the reference (ref :103-112).
+    if is_lm:
+        from distributed_pytorch_training_tpu.data.text import (
+            TokenLoader, get_token_dataset,
+        )
+
+        family = "bert" if args.model.startswith("bert") else "gpt2"
+        seq_len = args.seq_len or (512 if family == "bert" else 1024)
+
+        def _load_datasets():
+            train_ds = get_token_dataset(family, seq_len, args.data_dir,
+                                         train=True,
+                                         synthetic_size=args.synthetic_size,
+                                         seed=args.seed)
+            val_ds = get_token_dataset(family, seq_len, args.data_dir,
+                                       train=False,
+                                       synthetic_size=(args.synthetic_size or 0) // 5 or None,
+                                       seed=args.seed)
+            return train_ds, val_ds
+    else:
+        def _load_datasets():
+            train_ds = get_dataset(args.dataset, args.data_dir, train=True,
+                                   synthetic=args.synthetic,
+                                   synthetic_size=args.synthetic_size, seed=args.seed)
+            val_ds = get_dataset(args.dataset, args.data_dir, train=False,
+                                 synthetic=args.synthetic or train_ds.synthetic,
+                                 synthetic_size=(args.synthetic_size or 0) // 5 or None,
+                                 seed=args.seed)
+            return train_ds, val_ds
+
+    if ctx.is_main:
+        train_ds, val_ds = _load_datasets()
+        barrier("data_ready")
+    else:
+        barrier("data_ready")
+        train_ds, val_ds = _load_datasets()
+    if train_ds.synthetic:
+        log_main(f"NOTE: using synthetic data ({train_ds.name}, n={len(train_ds)})")
+
+    # Loaders + model + task (ref :131-148, :335-338).
+    if is_lm:
+        from distributed_pytorch_training_tpu.training.tasks import (
+            LanguageModelingTask, MaskedLMTask,
+        )
+
+        train_loader = TokenLoader(train_ds, mesh, args.batch_size, shuffle=True,
+                                   seed=args.seed, drop_last=args.drop_last)
+        val_loader = TokenLoader(val_ds, mesh, args.batch_size, shuffle=False,
+                                 seed=args.seed)
+        lm_kwargs = dict(dtype=compute_dtype)
+        if args.attention != "xla":
+            if family == "bert":
+                raise ValueError("--attention flash/ring is causal-only; "
+                                 "bert_base uses the XLA attention path")
+            if args.attention == "flash":
+                from distributed_pytorch_training_tpu.ops import (
+                    make_flash_attention_fn,
+                )
+                lm_kwargs["attention_fn"] = make_flash_attention_fn(causal=True)
+            else:  # ring
+                from distributed_pytorch_training_tpu.ops import (
+                    make_ring_attention_fn,
+                )
+                lm_kwargs["attention_fn"] = make_ring_attention_fn(
+                    mesh, causal=True)
+        model = get_model(args.model, **lm_kwargs)
+        if family == "bert":
+            task = MaskedLMTask(vocab_size=train_ds.vocab_size,
+                                compute_dtype=compute_dtype)
+        else:
+            task = LanguageModelingTask(compute_dtype=compute_dtype)
+        sample_input = np.zeros((1, seq_len), np.int32)
+    else:
+        train_loader = ShardedLoader(train_ds, mesh, args.batch_size, shuffle=True,
+                                     seed=args.seed, drop_last=args.drop_last,
+                                     prefetch=max(2, args.workers // 2))
+        val_loader = ShardedLoader(val_ds, mesh, args.batch_size, shuffle=False,
+                                   seed=args.seed, prefetch=2)
+        mean, std = IMAGE_STATS[args.dataset.lower()]
+        model_kwargs = dict(num_classes=train_ds.num_classes, dtype=compute_dtype)
+        if args.model.startswith("resnet"):
+            model_kwargs["cifar_stem"] = args.cifar_stem
+        model = get_model(args.model, **model_kwargs)
+        task = ImageClassificationTask(mean=mean, std=std,
+                                       augment=not args.no_augment,
+                                       compute_dtype=compute_dtype)
+        h, w = train_ds.images.shape[1:3]
+        sample_input = np.zeros((1, h, w, 3), np.float32)
+
+    # Optimizer (ref :339-344; schedule is an extension, ref is constant-LR).
+    steps_per_epoch = len(train_loader)
+    schedule = make_schedule(args.schedule, args.lr,
+                             total_steps=steps_per_epoch * args.epochs,
+                             warmup_steps=args.warmup_steps)
+    tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
+                        weight_decay=args.weight_decay)
+
+    trainer = Trainer(task, mesh,
+                      TrainConfig(per_device_batch=args.batch_size,
+                                  print_freq=args.print_freq, seed=args.seed,
+                                  bf16=args.amp),
+                      rules=type(model).partition_rules() if hasattr(type(model), "partition_rules") else None)
+
+    state = trainer.init_state(model, sample_input, tx,
+                               jax.random.PRNGKey(args.seed))
+    log_main(f"Model {args.model}: {state.param_count():,} params")
+
+    # Checkpointing (extension; the reference has none — SURVEY.md §5).
+    ckpt = None
+    start_epoch = 0
+    if args.checkpoint_dir:
+        from distributed_pytorch_training_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        if args.resume:
+            restored = ckpt.restore_latest(state)
+            if restored is not None:
+                state, start_epoch = restored
+                log_main(f"Resumed from epoch {start_epoch}")
+
+    csv = MetricsCSV(args.output_dir)  # ref :349-354
+
+    profiler = None
+    if args.profile_dir:
+        from distributed_pytorch_training_tpu.utils.profiling import StepProfiler
+
+        start, stop = (int(x) for x in args.profile_steps.split(","))
+        profiler = StepProfiler(args.profile_dir, start, stop)
+
+    for epoch in range(start_epoch, args.epochs):  # ref :356
+        counts = samples_per_step_list(len(train_ds), global_batch,
+                                       steps_per_epoch, args.drop_last)
+        state, train_loss, train_acc, epoch_time = trainer.train_epoch(
+            state, train_loader.epoch(epoch), epoch, steps_per_epoch,
+            samples_per_step=counts, step_hook=profiler)
+
+        val_loss, val_acc = trainer.evaluate(state, val_loader.epoch(0))
+
+        # Epoch summary + CSV row (ref :373-384, formats identical).
+        log_main(
+            f"[Epoch {epoch + 1}/{args.epochs}] "
+            f"Train: loss={train_loss:.4f}, acc={train_acc:.2f}% | "
+            f"Val: loss={val_loss:.4f}, acc={val_acc:.2f}% | "
+            f"Epoch time: {epoch_time:.2f}s"
+        )
+        csv.append(epoch, train_loss, train_acc, val_loss, val_acc, epoch_time)
+
+        if ckpt and (epoch + 1) % args.checkpoint_every == 0:
+            ckpt.save(epoch + 1, state)
+
+    if profiler:
+        profiler.close()
+    if ckpt:
+        ckpt.wait()  # finalize async writes before exit
+        ckpt.close()
+    cleanup_distributed()  # ref :386
+
+
+if __name__ == "__main__":
+    main()
