@@ -74,7 +74,11 @@ def load_cifar10(data_dir: str, train: bool) -> Optional[ArrayDataset]:
             entry = pickle.load(f, encoding="latin1")
         xs.append(np.asarray(entry["data"], np.uint8))
         ys.append(np.asarray(entry.get("labels", entry.get("fine_labels")), np.int32))
-    images = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).copy()
+    # CHW-planar records -> NHWC, decoded by the native runtime when present
+    # (the torchvision C++ image-op role, SURVEY.md §2b).
+    from ..native import chw_to_hwc_u8
+
+    images = chw_to_hwc_u8(np.concatenate(xs), 3, 32, 32)
     return ArrayDataset(images, np.concatenate(ys), num_classes=10,
                         name="cifar10", synthetic=False)
 

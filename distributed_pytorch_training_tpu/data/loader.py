@@ -26,6 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import native
 from ..parallel.mesh import batch_shard_count
 from ..parallel.sharding import shard_batch
 from .datasets import ArrayDataset
@@ -66,14 +67,43 @@ class ShardedLoader:
         images, labels = self.dataset.images, self.dataset.labels
         for idx, w in self.sampler.iter_epoch(epoch):
             yield {
-                "image": images[idx],
+                "image": native.gather_rows(images, idx),
                 "label": labels[idx],
                 "weight": w,
             }
 
+    def _native_epoch(self, epoch: int) -> Optional[Iterator[Dict[str, jax.Array]]]:
+        """Epoch served by the C++ prefetcher (native/): batch assembly runs
+        in native threads off the GIL, `prefetch` buffers deep. Returns None
+        when the native library is unavailable (no toolchain / disabled)."""
+        if not native.is_available():
+            return None
+        idx, w = self.sampler.epoch_indices(epoch)
+
+        def gen():
+            pf = native.NativePrefetcher(
+                self.dataset.images, self.dataset.labels, idx, w,
+                depth=self.prefetch)
+            try:
+                for img, lab, weight in pf:
+                    yield shard_batch(
+                        {"image": img, "label": lab, "weight": weight},
+                        self.mesh)
+            finally:
+                pf.close()
+
+        return gen()
+
     def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
         """Sharded device batches for one epoch. `epoch` seeds the reshuffle
         (the `set_epoch` contract, ref :184-185)."""
+        it = self._native_epoch(epoch)
+        if it is not None:
+            return it
+        return self._python_epoch(epoch)
+
+    def _python_epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+        """Pure-Python fallback: background thread + queue prefetch."""
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         stop = threading.Event()
@@ -93,10 +123,16 @@ class ShardedLoader:
             except BaseException as e:  # surfaced in the consumer
                 err.append(e)
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass  # consumer is gone; stop flag ends the thread
+                # The sentinel MUST land or the consumer blocks forever on
+                # q.get(); retry with the same stop-aware loop as batches
+                # (the queue may legitimately be full while the consumer is
+                # still draining).
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()

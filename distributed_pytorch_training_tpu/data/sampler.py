@@ -22,6 +22,8 @@ from typing import Iterator, Tuple
 
 import numpy as np
 
+from .. import native
+
 
 @dataclasses.dataclass
 class ShardedSampler:
@@ -63,7 +65,10 @@ class ShardedSampler:
         as `set_epoch`, ref :185) so shards are disjoint and exhaustive.
         """
         if self.shuffle:
-            order = np.random.RandomState(self.seed + epoch).permutation(self.n)
+            # Native splitmix64 Fisher-Yates (native/, with a bit-identical
+            # Python mirror) — every host derives the same order from
+            # seed+epoch whether or not it has a C++ toolchain.
+            order = native.permutation(self.seed + epoch, self.n)
         else:
             order = np.arange(self.n)
         steps = self.steps_per_epoch()

@@ -1,0 +1,306 @@
+// dpt_native — C++ host-side data runtime for the TPU training framework.
+//
+// Role: the native work PyTorch's C++ DataLoader core + torchvision image ops
+// perform for the reference (/root/reference/train_ddp.py:131-148 — worker
+// processes, pinned buffers, prefetch; SURVEY.md §2b "DataLoader worker
+// processes"). On TPU the device-side pipeline is XLA; the host side — record
+// decode, batch assembly, prefetch — is genuinely CPU work and lives here,
+// off the GIL, with a thread pool and a bounded ring buffer.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Components:
+//   * chw->hwc u8 record decode (the CIFAR python-pickle layout stores 3072-
+//     byte CHW planes; devices want NHWC interleave)  — parallel over records
+//   * row gather (batch assembly from a shuffled index set) — parallel memcpy
+//   * splitmix64-seeded Fisher-Yates permutation (deterministic host shuffle)
+//   * Prefetcher: producer thread + thread-pool gather filling a bounded ring
+//     of reusable batch buffers; consumer pops in order. This is the
+//     DataLoader(num_workers>0) equivalent: batch t+depth assembles while the
+//     device runs step t.
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+int32_t dpt_version() { return 1; }
+
+// ---------------------------------------------------------------- thread fan
+// One-shot fan-out for the standalone entry points (called once per epoch /
+// dataset load, where thread spawn cost is immaterial).
+static void parallel_for(int64_t n, int threads,
+                         const std::function<void(int64_t, int64_t)>& fn) {
+  if (threads <= 1 || n < 2) {
+    fn(0, n);
+    return;
+  }
+  int t = std::min<int64_t>(threads, n);
+  std::vector<std::thread> pool;
+  pool.reserve(t);
+  int64_t chunk = (n + t - 1) / t;
+  for (int i = 0; i < t; ++i) {
+    int64_t lo = i * chunk, hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Persistent worker pool for the per-batch hot loop (the Prefetcher): threads
+// live for the pool's lifetime; `run` fans a [0, n) range out as chunks, the
+// caller participates, and returns when every chunk is done.
+class Pool {
+ public:
+  explicit Pool(int workers) {
+    for (int i = 0; i < workers; ++i)
+      threads_.emplace_back([this] { loop(); });
+  }
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_task_.notify_all();
+    for (auto& t : threads_) t.join();
+  }
+
+  void run(int64_t n, const std::function<void(int64_t, int64_t)>& fn) {
+    if (threads_.empty() || n < 2) {
+      fn(0, n);
+      return;
+    }
+    int64_t parts = std::min<int64_t>((int64_t)threads_.size() + 1, n);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      fn_ = &fn;
+      total_ = n;
+      chunk_ = (n + parts - 1) / parts;
+      next_ = 0;
+      inflight_ = 0;
+    }
+    cv_task_.notify_all();
+    work();  // caller participates
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_done_.wait(lk, [this] { return next_ >= total_ && inflight_ == 0; });
+    fn_ = nullptr;
+  }
+
+ private:
+  void work() {
+    for (;;) {
+      int64_t lo, hi;
+      const std::function<void(int64_t, int64_t)>* fn;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (fn_ == nullptr || next_ >= total_) return;
+        lo = next_;
+        hi = std::min(total_, lo + chunk_);
+        next_ = hi;
+        ++inflight_;
+        fn = fn_;
+      }
+      (*fn)(lo, hi);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        --inflight_;
+        if (next_ >= total_ && inflight_ == 0) cv_done_.notify_all();
+      }
+    }
+  }
+
+  void loop() {
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_task_.wait(lk, [this] {
+          return stop_ || (fn_ != nullptr && next_ < total_);
+        });
+        if (stop_) return;
+      }
+      work();
+    }
+  }
+
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::condition_variable cv_task_, cv_done_;
+  const std::function<void(int64_t, int64_t)>* fn_ = nullptr;
+  int64_t total_ = 0, chunk_ = 0, next_ = 0, inflight_ = 0;
+  bool stop_ = false;
+};
+
+// ------------------------------------------------------------------- decode
+// src: (n, c*hw) planar records; dst: (n, hw*c) interleaved.
+void dpt_chw_to_hwc_u8(const uint8_t* src, uint8_t* dst, int64_t n, int64_t c,
+                       int64_t hw, int32_t threads) {
+  parallel_for(n, threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* rec = src + i * c * hw;
+      uint8_t* out = dst + i * c * hw;
+      for (int64_t p = 0; p < hw; ++p)
+        for (int64_t ch = 0; ch < c; ++ch) out[p * c + ch] = rec[ch * hw + p];
+    }
+  });
+}
+
+// ------------------------------------------------------------------- gather
+void dpt_gather_rows_u8(const uint8_t* src, const int64_t* idx, uint8_t* dst,
+                        int64_t batch, int64_t row_bytes, int32_t threads) {
+  parallel_for(batch, threads, [=](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+  });
+}
+
+// -------------------------------------------------------------- permutation
+static inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Unbiased Fisher-Yates via rejection-free Lemire reduction is overkill here;
+// modulo bias at n << 2^64 is negligible for shuffling, but do Lemire anyway.
+static inline uint64_t bounded(uint64_t& s, uint64_t n) {
+  __uint128_t m = (__uint128_t)splitmix64(s) * n;
+  return (uint64_t)(m >> 64);
+}
+
+void dpt_permutation(uint64_t seed, int64_t n, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t s = seed ^ 0xda3e39cb94b95bdbULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    int64_t j = (int64_t)bounded(s, (uint64_t)i + 1);
+    std::swap(out[i], out[j]);
+  }
+}
+
+// ---------------------------------------------------------------- prefetcher
+struct Slot {
+  std::vector<uint8_t> img;
+  std::vector<int32_t> lab;
+  std::vector<float> w;
+  int64_t step = -1;
+  bool ready = false;
+};
+
+struct Prefetcher {
+  const uint8_t* images;
+  const int32_t* labels;
+  int64_t row_bytes, steps, batch;
+  std::vector<int64_t> indices;  // (steps*batch), owned copy
+  std::vector<float> weights;    // (steps*batch), owned copy
+  int threads;
+  std::unique_ptr<Pool> pool;  // persistent: no thread churn per batch
+
+  std::vector<Slot> slots;
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  int64_t next_consume = 0;
+  std::atomic<bool> stop{false};
+  std::thread producer;
+
+  void run() {
+    for (int64_t t = 0; t < steps && !stop.load(); ++t) {
+      Slot& s = slots[t % slots.size()];
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        // wait until the slot's previous occupant (step t-depth) is consumed
+        cv_prod.wait(lk, [&] {
+          return stop.load() || t - next_consume < (int64_t)slots.size();
+        });
+        if (stop.load()) return;
+      }
+      const int64_t* idx = indices.data() + t * batch;
+      uint8_t* img_out = s.img.data();
+      pool->run(batch, [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i)
+          std::memcpy(img_out + i * row_bytes, images + idx[i] * row_bytes,
+                      row_bytes);
+      });
+      for (int64_t i = 0; i < batch; ++i) s.lab[i] = labels[idx[i]];
+      std::memcpy(s.w.data(), weights.data() + t * batch,
+                  batch * sizeof(float));
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        s.step = t;
+        s.ready = true;
+      }
+      cv_cons.notify_all();
+    }
+  }
+};
+
+void* dpt_prefetch_create(const uint8_t* images, const int32_t* labels,
+                          int64_t row_bytes, const int64_t* indices,
+                          const float* weights, int64_t steps, int64_t batch,
+                          int32_t depth, int32_t threads) {
+  auto* p = new Prefetcher;
+  p->images = images;
+  p->labels = labels;
+  p->row_bytes = row_bytes;
+  p->steps = steps;
+  p->batch = batch;
+  p->indices.assign(indices, indices + steps * batch);
+  p->weights.assign(weights, weights + steps * batch);
+  p->threads = std::max(1, threads);
+  p->pool.reset(new Pool(p->threads - 1));
+  depth = std::max(1, depth);
+  p->slots.resize(depth);
+  for (auto& s : p->slots) {
+    s.img.resize(batch * row_bytes);
+    s.lab.resize(batch);
+    s.w.resize(batch);
+  }
+  p->producer = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Blocks for the next in-order batch; copies into caller buffers. Returns the
+// step index, or -1 when the epoch is exhausted.
+int64_t dpt_prefetch_next(void* handle, uint8_t* out_img, int32_t* out_lab,
+                          float* out_w) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  int64_t t = p->next_consume;
+  if (t >= p->steps) return -1;
+  Slot& s = p->slots[t % p->slots.size()];
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_cons.wait(lk, [&] {
+      return p->stop.load() || (s.ready && s.step == t);
+    });
+    if (p->stop.load()) return -1;
+    std::memcpy(out_img, s.img.data(), p->batch * p->row_bytes);
+    std::memcpy(out_lab, s.lab.data(), p->batch * sizeof(int32_t));
+    std::memcpy(out_w, s.w.data(), p->batch * sizeof(float));
+    s.ready = false;
+    p->next_consume = t + 1;
+  }
+  p->cv_prod.notify_all();
+  return t;
+}
+
+void dpt_prefetch_destroy(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  p->stop.store(true);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    for (auto& s : p->slots) s.ready = false;  // unblock nothing-to-consume
+  }
+  p->cv_prod.notify_all();
+  p->cv_cons.notify_all();
+  if (p->producer.joinable()) p->producer.join();
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,155 @@
+"""Pipeline parallelism over the mesh ``pipe`` axis (GPipe-style SPMD).
+
+Absent from the reference (pure DDP, SURVEY.md §2c "PP: absent"); built here
+the TPU-native way: no per-stage processes or send/recv threads — ONE jitted
+SPMD program in which the stage-stacked layer parameters are sharded over the
+``pipe`` mesh axis and activations rotate between neighbor stages with
+``lax.ppermute`` (one ICI hop per tick).
+
+Schedule: classic GPipe. The local batch splits into M microbatches; at tick
+t, stage p computes microbatch ``t - p`` (valid when 0 <= t-p < M), so the
+pipeline fills for P-1 ticks, streams, and drains for P-1 ticks — bubble
+fraction (P-1)/(M+P-1). All control flow is a ``lax.scan`` over M+P-1 ticks
+with uniform per-device computation, exactly what XLA wants; autodiff of the
+scan+ppermute yields the reverse schedule (cotangents ride the ring backward),
+so no hand-written backward pass is needed.
+
+Layers inside a stage run under a second ``lax.scan`` over the stacked layer
+params (the standard scan-over-layers trick — one compiled block body,
+L iterations).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import BATCH_AXES, PIPE
+from .sharding import batch_spec
+
+
+def init_stacked_layers(module, rng: jax.Array, sample: jnp.ndarray,
+                        num_layers: int, **apply_kwargs) -> Any:
+    """Init `num_layers` i.i.d. copies of a layer module, stacked on a new
+    leading axis (leaf shapes (L, ...)). The stack feeds scan-over-layers and,
+    reshaped to (P, L/P, ...), the pipeline."""
+    keys = jax.random.split(rng, num_layers)
+
+    def init_one(key):
+        return module.init(key, sample, **apply_kwargs)["params"]
+
+    return jax.vmap(init_one)(keys)
+
+
+def stack_to_stages(stacked: Any, num_stages: int) -> Any:
+    """(L, ...) layer stack -> (P, L/P, ...) stage-major stack (leading axis
+    shardable over ``pipe``)."""
+
+    def reshape(leaf):
+        l = leaf.shape[0]
+        if l % num_stages:
+            raise ValueError(
+                f"{l} layers not divisible into {num_stages} pipeline stages")
+        return leaf.reshape(num_stages, l // num_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, stacked)
+
+
+def stage_params_spec(stage_params: Any) -> Any:
+    """PartitionSpec pytree: leading (stage) axis on ``pipe``, rest replicated."""
+    return jax.tree_util.tree_map(
+        lambda leaf: P(PIPE, *([None] * (leaf.ndim - 1))), stage_params)
+
+
+def pipeline_apply(
+    apply_layer: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    mesh: Mesh,
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """Run a stage-stacked layer sequence as a GPipe pipeline.
+
+    Args:
+      apply_layer: ``(layer_params, x) -> y`` for ONE layer (unstacked leaves).
+      stage_params: leaves shaped (P, L/P, ...), leading axis sharded on
+        ``pipe`` (see `stack_to_stages` / `stage_params_spec`).
+      x: (B, ...) activations, batch-sharded over (data, fsdp).
+      mesh: device mesh; ``mesh.shape['pipe']`` = number of stages.
+      num_microbatches: M; local batch per device must divide by it.
+
+    Returns (B, ...) outputs, batch-sharded, identical (up to fp reassoc) to
+    applying all P*L layers sequentially.
+    """
+    n_stages = mesh.shape[PIPE]
+    if n_stages == 1:
+        # Degenerate single-stage pipeline: plain scan over layers.
+        def body(h, layer):
+            return apply_layer(layer, h), None
+
+        merged = jax.tree_util.tree_map(
+            lambda leaf: leaf.reshape(-1, *leaf.shape[2:]), stage_params)
+        return lax.scan(body, x, merged)[0]
+
+    p_spec = stage_params_spec(stage_params)
+    x_spec = batch_spec(x.ndim)
+
+    def spmd(params, xs):  # params leaves (1, L/P, ...); xs local batch shard
+        my_params = jax.tree_util.tree_map(lambda a: a[0], params)
+        p = lax.axis_index(PIPE)
+        n = lax.psum(1, PIPE)
+        b = xs.shape[0]
+        m = num_microbatches
+        if b % m:
+            raise ValueError(
+                f"local batch {b} not divisible into {m} microbatches")
+        mb = xs.reshape(m, b // m, *xs.shape[1:])
+
+        def run_stage(h):
+            def body(h, layer):
+                return apply_layer(layer, h), None
+
+            return lax.scan(body, h, my_params)[0]
+
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t (clipped during drain ticks)
+            inject = mb[jnp.clip(t, 0, m - 1)]
+            h_in = jnp.where(p == 0, inject, state)
+            y = run_stage(h_in)
+            # last stage emits microbatch t-(n-1) (invalid during fill ticks)
+            m_out = t - (n - 1)
+            emit = (p == n - 1) & (m_out >= 0)
+            outs = jnp.where(
+                emit, outs.at[jnp.clip(m_out, 0, m - 1)].set(y), outs)
+            state = lax.ppermute(y, PIPE, fwd_perm)
+            return (state, outs), None
+
+        init = (jnp.zeros_like(mb[0]), jnp.zeros_like(mb))
+        (_, outs), _ = lax.scan(tick, init, jnp.arange(m + n - 1))
+        # Broadcast the finished microbatches from the last stage to every
+        # stage (one psum), so downstream (head/loss) is stage-agnostic.
+        outs = lax.psum(jnp.where(p == n - 1, outs, jnp.zeros_like(outs)),
+                        PIPE)
+        return outs.reshape(b, *xs.shape[1:])
+
+    return jax.shard_map(spmd, mesh=mesh, in_specs=(p_spec, x_spec),
+                         out_specs=x_spec, check_vma=False)(stage_params, x)
+
+
+def sequential_apply(apply_layer: Callable, stacked_params: Any,
+                     x: jnp.ndarray) -> jnp.ndarray:
+    """Reference semantics for tests: the same layers, applied in order
+    without a pipeline ((L, ...) leaves)."""
+
+    def body(h, layer):
+        return apply_layer(layer, h), None
+
+    return lax.scan(body, x, stacked_params)[0]
