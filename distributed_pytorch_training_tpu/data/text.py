@@ -17,6 +17,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from .. import native
 from ..parallel.mesh import batch_shard_count
 from ..parallel.sharding import shard_batch
 from .sampler import ShardedSampler
@@ -122,6 +123,7 @@ class TokenLoader:
     def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
         for idx, w in self.sampler.iter_epoch(epoch):
             yield shard_batch({
-                "input_ids": self.dataset.tokens[idx],
+                # native byte-wise row gather (works for int32 rows too)
+                "input_ids": native.gather_rows(self.dataset.tokens, idx),
                 "weight": w,
             }, self.mesh)

@@ -1,0 +1,11 @@
+"""Experiment drivers — the studies the reference's README promises but never
+fills in (/root/reference/README.md:25-35 "Experiments & Results": single vs
+multi-device scaling, throughput vs batch size, mixed-precision speedup, and
+the gradient-sync share of step time).
+
+Run as modules, e.g.::
+
+    python -m distributed_pytorch_training_tpu.experiments.scaling scaling
+    python -m distributed_pytorch_training_tpu.experiments.scaling amp
+    python -m distributed_pytorch_training_tpu.experiments.scaling gradsync
+"""

@@ -15,3 +15,4 @@ from . import resnet  # noqa: F401  (registers resnet18/resnet50)
 from . import vit  # noqa: F401  (registers vit_b16)
 from . import bert  # noqa: F401  (registers bert_base)
 from . import gpt2  # noqa: F401  (registers gpt2_355m/gpt2_124m)
+from . import moe  # noqa: F401  (registers gpt2_moe)

@@ -91,11 +91,16 @@ class GPT2LMHead(nn.Module):
 
 @register_model("gpt2_355m")
 def gpt2_355m(**kw) -> GPT2LMHead:
-    """GPT-2 medium (355M)."""
-    return GPT2LMHead(hidden_dim=1024, depth=24, num_heads=16, **kw)
+    """GPT-2 medium (355M). Config values are defaults — callers (tests,
+    dry-runs) may override any of them."""
+    cfg = dict(hidden_dim=1024, depth=24, num_heads=16)
+    cfg.update(kw)
+    return GPT2LMHead(**cfg)
 
 
 @register_model("gpt2_124m")
 def gpt2_124m(**kw) -> GPT2LMHead:
     """GPT-2 small — CPU-testable sibling of the 355M flagship."""
-    return GPT2LMHead(hidden_dim=768, depth=12, num_heads=12, **kw)
+    cfg = dict(hidden_dim=768, depth=12, num_heads=12)
+    cfg.update(kw)
+    return GPT2LMHead(**cfg)

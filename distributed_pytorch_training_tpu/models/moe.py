@@ -1,0 +1,227 @@
+"""Mixture-of-Experts MLP with expert parallelism over the mesh ``expert`` axis.
+
+No analogue in the reference (ResNet-only; SURVEY.md §2c "EP: absent — note as
+extension"); this is the extension, built the TPU way: token-choice top-k
+routing in the dense einsum formulation (fixed capacity per expert, one-hot
+dispatch/combine tensors), so every shape is static and the whole layer is
+three einsums XLA can tile onto the MXU. With the stacked expert weights
+sharded ``P("expert", ...)``, XLA lowers the dispatch/return einsums to
+all-to-alls over the ``expert`` mesh axis — expert parallelism falls out of
+layout, exactly like gradient sync falls out of batch sharding.
+
+Load balancing: the standard Switch-Transformer auxiliary loss
+(num_experts * Σ_e fraction_tokens_e * fraction_router_prob_e), sown into the
+``"losses"`` collection; `MoeLanguageModelingTask` adds it to the CE loss.
+Tokens overflowing an expert's capacity are dropped (their combine weight is
+zero) — the residual path carries them unchanged, the standard behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import EXPERT
+from ..parallel.sharding import PartitionRules
+from .registry import register_model
+from jax.sharding import PartitionSpec as P
+
+Dtype = Any
+
+
+class MoeMlp(nn.Module):
+    """Top-k token-choice MoE feed-forward (drop-in for MlpBlock)."""
+
+    num_experts: int
+    hidden_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    activation: Callable = nn.gelu
+    router_noise: float = 0.0  # jitter std during training, 0 = off
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        # GShard-style GROUP-WISE dispatch: each batch row is a routing group
+        # with its own capacity ceil(S/E * cf). Dispatch/combine tensors are
+        # (B, S, E, C) — linear in total token count (a global-N capacity
+        # would make them quadratic and OOM at real batch x seq sizes).
+        b, s, d = x.shape
+        e = self.num_experts
+        cap = max(1, int(np.ceil(s / e * self.capacity_factor)))
+
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          param_dtype=self.param_dtype, name="router")
+        logits = router(x.astype(jnp.float32))  # (B, S, E), fp32 softmax
+        if self.router_noise and not deterministic:
+            key = self.make_rng("dropout")
+            logits = logits + self.router_noise * jax.random.normal(
+                key, logits.shape)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # --- top-k dispatch with fixed per-group capacity ------------------
+        combine = jnp.zeros((b, s, e, cap), jnp.float32)
+        fill = jnp.zeros((b, e), jnp.int32)  # slots taken, per group
+        remaining = probs
+        total_dispatch = jnp.zeros((b, s, e), jnp.float32)
+        for _ in range(self.top_k):
+            choice = jnp.argmax(remaining, axis=-1)  # (B, S)
+            onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # (B, S, E)
+            gate = (probs * onehot).sum(-1)  # (B, S)
+            # position of each token within its expert's buffer (per group):
+            pos = (jnp.cumsum(onehot, axis=1) - 1.0) + fill[:, None, :]
+            pos_tok = (pos * onehot).sum(-1).astype(jnp.int32)  # (B, S)
+            keep = pos_tok < cap
+            slot = jax.nn.one_hot(pos_tok, cap, dtype=jnp.float32)  # (B, S, C)
+            disp = onehot * keep[..., None]  # (B, S, E)
+            combine = combine + (gate[..., None, None] * disp[..., None]
+                                 * slot[..., None, :])
+            total_dispatch = total_dispatch + disp
+            fill = fill + disp.sum(1).astype(jnp.int32)
+            remaining = remaining * (1.0 - onehot)  # mask chosen expert
+
+        # --- auxiliary load-balancing loss (Switch eq. 4, over all tokens) -
+        frac_tokens = total_dispatch.reshape(-1, e).mean(0)
+        frac_probs = probs.reshape(-1, e).mean(0)
+        aux = e * jnp.sum(frac_tokens * frac_probs) / self.top_k
+        self.sow("losses", "moe_aux", aux)
+
+        # --- expert computation (stacked weights, EP via sharding) ---------
+        wi = self.param("wi", nn.initializers.lecun_normal(batch_axis=(0,)),
+                        (e, d, self.hidden_dim), self.param_dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+                        (e, self.hidden_dim, d), self.param_dtype)
+        dispatch = (combine > 0).astype(self.dtype)  # (B, S, E, C)
+        xin = jnp.einsum("bsec,bsd->becd", dispatch,
+                         x.astype(self.dtype))  # (B, E, C, d)
+        h = self.activation(jnp.einsum("becd,edh->bech", xin,
+                                       wi.astype(self.dtype)))
+        out = jnp.einsum("bech,ehd->becd", h, wo.astype(self.dtype))
+        y = jnp.einsum("bsec,becd->bsd", combine.astype(self.dtype), out)
+        return y
+
+
+def moe_rules() -> PartitionRules:
+    """Expert-parallel rules: stacked expert weights split over ``expert``;
+    the router stays replicated (it is tiny and every token needs it)."""
+    return PartitionRules([
+        (r"moe/wi", P(EXPERT, None, None)),
+        (r"moe/wo", P(EXPERT, None, None)),
+    ])
+
+
+class MoeTransformerBlock(nn.Module):
+    """Pre-LN block with the MoE feed-forward in place of the dense MLP."""
+
+    num_heads: int
+    head_dim: int
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.0
+    layernorm_epsilon: float = 1e-5
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        from .layers import MultiHeadAttention, dot_product_attention
+
+        ln_kw = dict(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                     param_dtype=self.param_dtype)
+        y = nn.LayerNorm(**ln_kw, name="ln1")(x)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            dropout_rate=self.dropout_rate,
+            attention_fn=self.attention_fn or dot_product_attention,
+            name="attn")(y, mask=mask, deterministic=deterministic)
+        x = x + y
+        y = nn.LayerNorm(**ln_kw, name="ln2")(x)
+        y = MoeMlp(num_experts=self.num_experts, hidden_dim=self.mlp_dim,
+                   top_k=self.top_k, capacity_factor=self.capacity_factor,
+                   dtype=self.dtype, param_dtype=self.param_dtype,
+                   name="moe")(y, deterministic=deterministic)
+        return x + y
+
+
+class GPT2MoELMHead(nn.Module):
+    """GPT-2-style causal LM with MoE feed-forwards on alternating layers
+    (the Switch/GShard layout: dense and MoE blocks interleave)."""
+
+    vocab_size: int = 50257
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2  # layer i is MoE iff i % moe_every == moe_every - 1
+    max_position: int = 1024
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    layernorm_epsilon: float = 1e-5
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, train: bool = False):
+        from .layers import TransformerBlock, causal_mask, dot_product_attention
+
+        b, s = input_ids.shape
+        wte = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype,
+                       param_dtype=self.param_dtype,
+                       embedding_init=nn.initializers.normal(stddev=0.02),
+                       name="wte")
+        x = wte(input_ids)
+        x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype,
+                         embedding_init=nn.initializers.normal(stddev=0.01),
+                         name="wpe")(jnp.arange(s)[None, :])
+
+        attn_fn = self.attention_fn or dot_product_attention
+        uses_kernel = attn_fn is not dot_product_attention
+        mask = None if uses_kernel else causal_mask(s)
+
+        head_dim = self.hidden_dim // self.num_heads
+        for i in range(self.depth):
+            if i % self.moe_every == self.moe_every - 1:
+                x = MoeTransformerBlock(
+                    num_heads=self.num_heads, head_dim=head_dim,
+                    num_experts=self.num_experts,
+                    mlp_dim=4 * self.hidden_dim, top_k=self.top_k,
+                    capacity_factor=self.capacity_factor, dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    layernorm_epsilon=self.layernorm_epsilon,
+                    attention_fn=self.attention_fn,
+                    name=f"block{i}")(x, mask=mask, deterministic=not train)
+            else:
+                x = TransformerBlock(
+                    num_heads=self.num_heads, head_dim=head_dim,
+                    mlp_dim=4 * self.hidden_dim, dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    layernorm_epsilon=self.layernorm_epsilon,
+                    attention_fn=attn_fn,
+                    name=f"block{i}")(x, mask=mask, deterministic=not train)
+
+        x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
+        return wte.attend(x).astype(jnp.float32)
+
+    @staticmethod
+    def partition_rules() -> PartitionRules:
+        from .layers import tp_rules
+
+        return tp_rules() + moe_rules()
+
+
+@register_model("gpt2_moe")
+def gpt2_moe(**kw) -> GPT2MoELMHead:
+    """GPT-2-small-sized MoE LM (8 experts, top-2, MoE every other layer)."""
+    return GPT2MoELMHead(**kw)

@@ -128,14 +128,24 @@ def chw_to_hwc_u8(records: np.ndarray, c: int, h: int, w: int) -> np.ndarray:
 
 def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """Batch assembly: rows of `src` at `idx` (NumPy fancy-index equivalent,
-    parallel memcpy off the GIL)."""
+    parallel memcpy off the GIL). Any contiguous dtype — the copy is
+    byte-wise, so int32 token rows work the same as uint8 image rows."""
     src = np.ascontiguousarray(src)
     lib = _load()
-    if lib is None or src.dtype != np.uint8:
+    # Only trivially-copyable numeric rows take the native memcpy path
+    # (object arrays hold PyObject pointers — memcpy would skip refcounting).
+    if lib is None or src.dtype.kind not in "biufc":
         return src[idx]
     idx = np.ascontiguousarray(idx, np.int64)
+    if len(idx) and (idx.min() < 0 or idx.max() >= len(src)):
+        # The C side is a raw memcpy with no bounds check; keep NumPy's
+        # loud failure instead of reading out-of-bounds host memory.
+        raise IndexError(
+            f"gather_rows indices out of range [0, {len(src)}): "
+            f"min={idx.min()}, max={idx.max()}")
     row_bytes = int(np.prod(src.shape[1:], dtype=np.int64)) * src.itemsize
     out = np.empty((len(idx), *src.shape[1:]), src.dtype)
+    # byte-pointer cast is dtype-agnostic: row_bytes covers the full row
     lib.dpt_gather_rows_u8(_ptr(src, ctypes.c_uint8),
                            _ptr(idx, ctypes.c_int64),
                            _ptr(out, ctypes.c_uint8),
@@ -196,6 +206,11 @@ class NativePrefetcher:
                 f"NativePrefetcher serves uint8 image batches, got "
                 f"dtype={images.dtype} ndim={images.ndim}")
         steps, batch = indices.shape
+        if indices.size and (indices.min() < 0
+                             or indices.max() >= len(images)):
+            raise IndexError(
+                f"prefetch indices out of range [0, {len(images)}): "
+                f"min={indices.min()}, max={indices.max()}")
         self._lib = lib
         # keep references so the buffers outlive the C++ pointers
         self._images = np.ascontiguousarray(images)

@@ -1,0 +1,102 @@
+"""Ulysses attention — sequence parallelism by head-sharding (all-to-all).
+
+The alternative SP mode SURVEY.md §2c lists alongside ring attention: instead
+of rotating K/V blocks around the ring (O(n) ppermute hops), ONE all-to-all
+re-shards the activations from sequence-sharded to head-sharded, every device
+computes FULL-sequence attention for its head slice, and a second all-to-all
+restores sequence sharding:
+
+    (B, S/n, H, D)  --all_to_all-->  (B, S, H/n, D)
+        full softmax(QK^T)V per local head group
+    (B, S, H/n, D)  --all_to_all-->  (B, S/n, H, D)
+
+Trade-off vs ring attention: 2 all-to-alls of the whole activation per layer
+(bandwidth) but full-sequence attention locally (no per-step latency chain);
+requires num_heads % n == 0, and memory is O(S) per device for the local
+heads — use ring attention when S itself cannot fit. Both compose inside jit
+via shard_map; `lax.all_to_all` has a transpose rule so gradients take the
+mirrored path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import BATCH_AXES, MODEL, SEQ
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _local_attention(q, k, v, q0: int, causal: bool, sm_scale: float):
+    """Plain attention over full sequence for a local head group. q may be a
+    sub-block starting at global row q0 (used for causal masking)."""
+    s_q, s_k = q.shape[1], k.shape[1]
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        rows = q0 + lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        logits = jnp.where((rows >= cols)[None, None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", weights,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # (B, S, H, D) — S sharded over `axis_name`
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    axis_name: str = SEQ,
+) -> jnp.ndarray:
+    """Head-sharded sequence-parallel attention over the mesh `seq` axis."""
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    n = mesh.shape[axis_name]
+    if n == 1:
+        return _local_attention(q, k, v, 0, causal, scale)
+    # Heads are head-sharded over `model` first (tp_rules) and then split
+    # again over `seq` by the all-to-all, so the constraint is on the product.
+    model_n = mesh.shape.get(MODEL, 1)
+    if q.shape[2] % (n * model_n):
+        raise ValueError(
+            f"ulysses attention needs num_heads ({q.shape[2]}) divisible by "
+            f"{axis_name!r} x 'model' axis sizes ({n} x {model_n}); use ring "
+            "attention when heads are too few")
+
+    def body(q_loc, k_loc, v_loc):  # (B, S/n, H, D) local shards
+        # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1)
+        to_heads = functools.partial(
+            lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+            tiled=True)
+        qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
+        out = _local_attention(qh, kh, vh, 0, causal, scale)  # (B, S, H/n, D)
+        # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2)
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    spec = P(BATCH_AXES, axis_name, MODEL, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def make_ulysses_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ):
+    """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`."""
+
+    def attention_fn(q, k, v, mask=None, dtype=jnp.float32):
+        if mask is not None:
+            raise ValueError(
+                "ulysses attention handles causal masking internally; "
+                "explicit masks require the XLA attention path")
+        return ulysses_attention(q, k, v, mesh, causal=causal,
+                                 axis_name=axis_name).astype(dtype)
+
+    return attention_fn

@@ -10,6 +10,7 @@ rule tables over the same machinery (SURVEY.md §2c).
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Any, Optional, Sequence, Tuple
 
@@ -18,6 +19,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import BATCH_AXES
+
+logger = logging.getLogger(__name__)
 
 
 class PartitionRules:
@@ -72,15 +75,53 @@ def tree_specs(tree: Any, rules: Optional[PartitionRules]) -> Any:
     )
 
 
+def feasible_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Drop spec entries whose mesh axes do not divide the dimension.
+
+    Rules describe the *intended* layout; some tensors cannot honor it (e.g.
+    a (50257, d) GPT-2 vocab embedding is not divisible by a model axis of
+    2 — Megatron pads the vocab; we keep exact parity shapes and replicate
+    that dim instead). Infeasible dims degrade to replication, per-dim."""
+    if not len(spec):
+        return spec
+    if len(spec) > len(shape):
+        # A rule matching a tensor of smaller rank is a bug in the rule
+        # table, not a layout infeasibility — keep the loud failure.
+        raise ValueError(
+            f"PartitionSpec {spec} has more entries than tensor rank "
+            f"{len(shape)} (shape {shape})")
+    entries = []
+    changed = False
+    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if entry is None:
+            entries.append(None)
+            continue
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        size = int(np.prod([mesh.shape[n] for n in names]))
+        if dim % size:
+            entries.append(None)
+            changed = True
+        else:
+            entries.append(entry)
+    if changed:
+        logger.debug("sharding degraded to %s for shape %s (indivisible)",
+                     entries, shape)
+    return P(*entries)
+
+
 def shard_pytree(tree: Any, mesh: Mesh, rules: Optional[PartitionRules] = None) -> Any:
     """Place a pytree on the mesh per the rules (replicated by default).
 
     This is the moment DDP performs its rank0->all param broadcast
     (train_ddp.py:305-310); here placement and layout are one operation.
+    Dims the rules would split unevenly are replicated instead (see
+    `feasible_spec`).
     """
     specs = tree_specs(tree, rules)
     return jax.tree_util.tree_map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        lambda leaf, spec: jax.device_put(
+            leaf,
+            NamedSharding(mesh, feasible_spec(spec, np.shape(leaf), mesh))),
         tree,
         specs,
     )

@@ -3,6 +3,7 @@
 from .dist import (  # noqa: F401
     DistContext,
     cleanup_distributed,
+    honor_platform_env,
     is_distributed,
     setup_distributed,
 )

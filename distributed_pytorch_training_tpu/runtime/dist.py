@@ -37,6 +37,19 @@ logger = logging.getLogger(__name__)
 _INITIALIZED = False
 
 
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu`` work even where an early jax import (e.g. a
+    sitecustomize that pins an accelerator platform list) has already captured
+    the config default. Call before first device use; no-op once the backend
+    is live. This is what lets one invocation run the same code on the real
+    chip or an N-virtual-device CPU mesh (the test/dry-run backend)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # backend already initialized on cpu — fine
+            pass
+
+
 @dataclasses.dataclass(frozen=True)
 class DistContext:
     """What `setup_distributed` returns — the TPU analogue of the reference's

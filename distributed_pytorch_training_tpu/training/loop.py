@@ -92,7 +92,14 @@ class Trainer:
         rules (replicated by default — the DDP broadcast moment, ref :305-310).
         `sample_input` is a (1, ...) array of the model's input shape/dtype
         (float images or int32 token ids)."""
+        from ..parallel.mesh import batch_shard_count
+
         x = jnp.asarray(sample_input)
+        # Models containing shard_map'd ops (ring attention) need the traced
+        # batch dim divisible by the mesh batch axes; tile the sample up.
+        n_shards = batch_shard_count(self.mesh)
+        if x.shape[0] % n_shards:
+            x = jnp.tile(x, (n_shards,) + (1,) * (x.ndim - 1))
         variables = model.init(init_rng, x, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})

@@ -1,0 +1,91 @@
+"""Preemption-aware training — the failure-recovery story.
+
+The reference has none (SURVEY.md §5 "Failure detection/elastic recovery:
+Absent — a crashed rank hangs the NCCL job"). TPU pods are preemptible, so
+the minimum useful story is: catch the preemption signal (SIGTERM), finish
+the in-flight step, write a checkpoint, exit 0; the relaunched job resumes
+from it (`--resume`). That turns a preemption from "lose the run" into "lose
+at most one epoch slice".
+
+No elastic re-sizing: XLA SPMD programs are compiled for a fixed mesh, so the
+honest TPU design is checkpoint-restart at the same (or re-specified)
+topology rather than DDP-style dynamic world resizing.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+from ..utils.logging import log_main
+
+
+class PreemptionGuard:
+    """Installs SIGTERM/SIGINT handlers that request a graceful stop.
+
+    Usage::
+
+        guard = PreemptionGuard.install()
+        for epoch in range(...):
+            train_epoch(...)
+            if guard.should_stop:
+                ckpt.save(epoch + 1, state, wait=True)
+                break
+
+    Handlers chain to any previously-installed handler; `should_stop` is a
+    plain flag so the hot loop pays nothing for it. Signals received twice
+    fall through to the previous handler (second Ctrl-C still kills).
+    """
+
+    _installed: Optional["PreemptionGuard"] = None
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._prev = {}
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def _handler(self, signum, frame):
+        if self._stop.is_set():
+            # second signal: defer to the previous behavior (hard exit)
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signum, prev or signal.SIG_DFL)
+                signal.raise_signal(signum)
+            return
+        log_main(f"Received signal {signum}: will checkpoint and stop at the "
+                 "next epoch boundary")
+        self._stop.set()
+
+    def reset(self) -> None:
+        """Disarm a previously-set stop flag (a new run starts fresh)."""
+        self._stop.clear()
+
+    @classmethod
+    def install(cls, reset: bool = True) -> "PreemptionGuard":
+        """Idempotent: repeated calls return the same guard. By default the
+        stale stop flag from a previous run in this process is cleared —
+        otherwise a sweep/notebook calling main() twice would silently stop
+        run 2 after one epoch because run 1 was preempted."""
+        if cls._installed is not None:
+            if reset:
+                cls._installed.reset()
+            return cls._installed
+        guard = cls()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                guard._prev[sig] = signal.signal(sig, guard._handler)
+            except (ValueError, OSError):
+                # non-main thread or restricted env: degrade to manual
+                # request_stop(); training still works
+                pass
+        cls._installed = guard
+        return guard

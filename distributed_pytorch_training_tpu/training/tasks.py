@@ -98,15 +98,19 @@ class LanguageModelingTask(Task):
     """Causal next-token prediction (the GPT-2 355M config, BASELINE.json:12).
 
     Batch: {"input_ids": (B, S) int32, "weight": (B,)}. Loss = CE of token
-    t+1 given tokens <=t, averaged over real (weighted) positions. "correct"
-    is next-token top-1 — so summarize() reports token accuracy.
+    t+1 given tokens <=t, averaged over real (weighted) positions, plus
+    `aux_loss_weight` x any auxiliary losses the model sows into its
+    ``"losses"`` collection (0 for dense models — sowing is a no-op there).
+    "correct" is next-token top-1 — so summarize() reports token accuracy.
     """
 
     compute_dtype: Any = jnp.float32
+    aux_loss_weight: float = 0.0
 
     def loss_and_metrics(self, state, params, batch, rng, train):
         ids = batch["input_ids"]
-        logits = state.apply_fn({"params": params}, ids, train=train)
+        logits, mutated = state.apply_fn(
+            {"params": params}, ids, train=train, mutable=["losses"])
         # shift: predict ids[:, 1:] from logits[:, :-1]
         tgt = ids[:, 1:]
         lg = logits[:, :-1].astype(jnp.float32)
@@ -114,10 +118,24 @@ class LanguageModelingTask(Task):
         w = batch["weight"][:, None] * jnp.ones_like(per_tok)
         wsum = w.sum()
         loss = (per_tok * w).sum() / jnp.maximum(wsum, 1.0)
+        if self.aux_loss_weight:
+            aux_leaves = jax.tree_util.tree_leaves(mutated.get("losses", {}))
+            if aux_leaves:
+                aux = (sum(jnp.asarray(a).mean() for a in aux_leaves)
+                       / len(aux_leaves))
+                loss = loss + self.aux_loss_weight * aux
         correct = ((jnp.argmax(lg, axis=-1) == tgt) * w).sum()
         metrics = {"loss_sum": (per_tok * w).sum(), "correct": correct,
                    "weight": wsum}
         return loss, (metrics, state.batch_stats)
+
+
+@dataclasses.dataclass
+class MoeLanguageModelingTask(LanguageModelingTask):
+    """Causal LM over an MoE model (models/moe.py): the base CE loss plus the
+    Switch-style router load-balancing loss the model sows (weight 0.01)."""
+
+    aux_loss_weight: float = 0.01
 
 
 @dataclasses.dataclass

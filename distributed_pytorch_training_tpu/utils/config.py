@@ -42,7 +42,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
 
     # --- TPU-native extensions ---
     parser.add_argument("--model", default="resnet18", type=str,
-                        help="model name (resnet18/resnet50/vit_b16/bert_base/gpt2_355m)")
+                        help="model name (resnet18/resnet50/vit_b16/bert_base/"
+                             "gpt2_124m/gpt2_355m/gpt2_moe)")
     parser.add_argument("--dataset", default="cifar10", type=str,
                         help="dataset name (cifar10/imagenet)")
     parser.add_argument("--synthetic", action="store_true",
@@ -57,10 +58,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="sequence length for LM configs (default: 512 "
                              "for bert_base, 1024 for gpt2)")
     parser.add_argument("--attention", default="xla", type=str,
-                        choices=["xla", "flash", "ring"],
+                        choices=["xla", "flash", "ring", "ulysses"],
                         help="attention implementation for causal LM configs: "
-                             "xla einsum, Pallas flash kernel, or ring "
-                             "(sequence-parallel over the mesh seq axis)")
+                             "xla einsum, Pallas flash kernel, ring (KV "
+                             "rotation over the mesh seq axis), or ulysses "
+                             "(all-to-all head sharding over seq)")
     parser.add_argument("--schedule", default="constant", type=str,
                         help="lr schedule: constant | cosine | linear_warmup")
     parser.add_argument("--warmup-steps", default=0, type=int)

@@ -1,0 +1,28 @@
+"""Experiment tooling (experiments/scaling.py): the HLO collective census
+must find the all-reduce XLA inserts for a cross-device reduction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_training_tpu.experiments.scaling import (
+    collective_census,
+)
+
+
+def test_census_finds_allreduce_in_sharded_reduction(mesh8):
+    sharding = NamedSharding(mesh8, P("data"))
+    x = jax.device_put(np.arange(32, dtype=np.float32), sharding)
+
+    f = jax.jit(lambda v: v.sum(), in_shardings=sharding,
+                out_shardings=NamedSharding(mesh8, P()))
+    text = f.lower(x).compile().as_text()
+    census = collective_census(text)
+    assert any(c["op"] == "all-reduce" for c in census), census
+
+
+def test_census_empty_on_local_computation():
+    f = jax.jit(lambda v: v * 2)
+    text = f.lower(jnp.ones(4)).compile().as_text()
+    assert collective_census(text) == []

@@ -1,0 +1,102 @@
+"""MoE layer + expert parallelism (models/moe.py).
+
+The dense-einsum top-k routing must (a) reduce to a plain MLP in the
+single-expert no-drop limit, (b) respect capacity, (c) train end-to-end with
+expert weights sharded over the ``expert`` mesh axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.models import get_model
+from distributed_pytorch_training_tpu.models.moe import (
+    GPT2MoELMHead,
+    MoeMlp,
+)
+from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+
+
+def test_single_expert_no_drop_equals_dense_mlp():
+    """E=1, top_k=1, ample capacity: routing is the identity (gate=1), so the
+    MoE layer must equal gelu(x@wi)@wo exactly."""
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 16), jnp.float32)
+    layer = MoeMlp(num_experts=1, hidden_dim=32, top_k=1, capacity_factor=2.0)
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    y = layer.apply({"params": params}, x)
+    wi, wo = params["wi"][0], params["wo"][0]
+    want = jax.nn.gelu(x.reshape(-1, 16) @ wi) @ wo
+    np.testing.assert_allclose(np.asarray(y).reshape(-1, 16),
+                               np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_capacity_drops_overflow_tokens():
+    """With capacity 1 slot/expert, at most E tokens can be processed; the
+    rest must contribute exactly zero (residual carries them)."""
+    n, e = 16, 2
+    x = jnp.asarray(np.random.RandomState(1).randn(1, n, 8), jnp.float32)
+    layer = MoeMlp(num_experts=e, hidden_dim=16, top_k=1,
+                   capacity_factor=e / n)  # cap = 1
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    y = np.asarray(layer.apply({"params": params}, x))[0]
+    nonzero_rows = (np.abs(y) > 1e-9).any(axis=-1).sum()
+    assert nonzero_rows <= e
+
+
+def test_aux_loss_sown_and_finite():
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 8, 16), jnp.float32)
+    layer = MoeMlp(num_experts=4, hidden_dim=32)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    _, mut = layer.apply({"params": variables["params"]}, x,
+                         mutable=["losses"])
+    (aux,) = jax.tree_util.tree_leaves(mut["losses"])
+    # Switch aux loss is >= 1 (perfect balance) and small at init
+    assert np.isfinite(float(aux)) and 0.5 < float(aux) < 4.0
+
+
+def test_gpt2_moe_forward_and_registry():
+    model = get_model("gpt2_moe", vocab_size=128, hidden_dim=32, depth=2,
+                      num_heads=2, num_experts=4, max_position=32)
+    assert isinstance(model, GPT2MoELMHead)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids, train=False)
+    logits = model.apply(variables, ids, train=False)
+    assert logits.shape == (2, 16, 128)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.slow
+def test_moe_trains_expert_parallel(devices):
+    """Full jitted train step with experts sharded over a real expert axis
+    (expert=4 x data=2 mesh on 8 virtual devices): the EP all-to-alls XLA
+    inserts must compile and produce finite loss + nonzero expert grads."""
+    from distributed_pytorch_training_tpu.parallel import shard_batch
+    from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
+    from distributed_pytorch_training_tpu.training.optim import adamw
+    from distributed_pytorch_training_tpu.training.tasks import (
+        MoeLanguageModelingTask,
+    )
+
+    mesh = build_mesh(MeshSpec(expert=4, data=2), devices=devices)
+    model = get_model("gpt2_moe", vocab_size=64, hidden_dim=16, depth=2,
+                      num_heads=2, num_experts=4, max_position=16)
+    task = MoeLanguageModelingTask()
+    trainer = Trainer(task, mesh, TrainConfig(seed=0),
+                      rules=GPT2MoELMHead.partition_rules())
+    state = trainer.init_state(model, np.zeros((1, 16), np.int32),
+                               adamw(1e-3), jax.random.PRNGKey(0))
+    # expert weights really are sharded over the expert axis
+    wi_shard = state.params["block1"]["moe"]["wi"].sharding.spec
+    assert wi_shard[0] == "expert"
+    wi_before = np.asarray(jax.device_get(state.params["block1"]["moe"]["wi"]))
+
+    batch = shard_batch({
+        "input_ids": np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32),
+        "weight": np.ones(8, np.float32),
+    }, mesh)
+    # state is donated by the compiled step; snapshot taken above
+    state2, metrics = trainer._train_step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss_sum"]))
+    wi_after = np.asarray(jax.device_get(state2.params["block1"]["moe"]["wi"]))
+    assert np.abs(wi_after - wi_before).sum() > 0  # experts actually updated

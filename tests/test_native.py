@@ -31,6 +31,15 @@ def test_gather_rows_matches_fancy_index():
     assert np.array_equal(native.gather_rows(src, idx), src[idx])
 
 
+def test_gather_rows_non_uint8_dtypes():
+    """The gather is byte-wise: int32 token rows and float32 rows round-trip
+    exactly (TokenLoader depends on this)."""
+    for dtype in (np.int32, np.float32, np.uint16):
+        src = (np.random.RandomState(3).rand(50, 12) * 100).astype(dtype)
+        idx = np.random.RandomState(4).randint(0, 50, 31)
+        assert np.array_equal(native.gather_rows(src, idx), src[idx]), dtype
+
+
 def test_permutation_is_deterministic_permutation():
     p = native.permutation(42, 5000)
     assert np.array_equal(np.sort(p), np.arange(5000))

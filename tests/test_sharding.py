@@ -81,6 +81,21 @@ def test_sharded_compute_correctness(devices):
     np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
 
 
+def test_indivisible_dim_degrades_to_replication(devices):
+    """A rule splitting a dim the mesh axis cannot divide (GPT-2's 50257-row
+    vocab embedding over model=2) must replicate that dim, not crash."""
+    from distributed_pytorch_training_tpu.parallel.sharding import feasible_spec
+
+    mesh = build_mesh(MeshSpec(data=4, model=2), devices=devices)
+    assert feasible_spec(P(MODEL, None), (50257, 8), mesh) == P(None, None)
+    assert feasible_spec(P(MODEL, None), (50258, 8), mesh) == P(MODEL, None)
+
+    rules = PartitionRules([(r"embedding", P(MODEL, None))])
+    tree = {"embedding": np.zeros((7, 8), np.float32)}  # 7 % 2 != 0
+    sharded = shard_pytree(tree, mesh, rules)
+    assert sharded["embedding"].sharding.spec == P(None, None)
+
+
 def test_shard_batch_scalar_leaf_is_replicated(mesh8):
     out = shard_batch({"x": np.zeros((16, 2), np.float32), "step": np.float32(3.0)}, mesh8)
     assert out["step"].sharding.spec == P()

@@ -38,8 +38,10 @@ from distributed_pytorch_training_tpu.models import get_model
 from distributed_pytorch_training_tpu.parallel import MeshSpec, barrier, build_mesh
 from distributed_pytorch_training_tpu.parallel.mesh import batch_shard_count
 from distributed_pytorch_training_tpu.runtime import (
-    cleanup_distributed, setup_distributed,
+    cleanup_distributed, honor_platform_env, setup_distributed,
 )
+
+honor_platform_env()  # JAX_PLATFORMS=cpu virtual-mesh runs work as expected
 from distributed_pytorch_training_tpu.training import (
     TrainConfig, Trainer, make_optimizer, make_schedule,
 )
@@ -65,6 +67,13 @@ def main(argv=None):
     args = parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         raise ValueError("--resume requires --checkpoint-dir")
+
+    # Preemption guard first: a SIGTERM during data load / compile must also
+    # lead to a graceful stop, not a mid-init kill (preemption.py docstring).
+    from distributed_pytorch_training_tpu.training.preemption import (
+        PreemptionGuard,
+    )
+    guard = PreemptionGuard.install()
     Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
 
     ctx = setup_distributed()  # ref :318
@@ -126,7 +135,7 @@ def main(argv=None):
     # Loaders + model + task (ref :131-148, :335-338).
     if is_lm:
         from distributed_pytorch_training_tpu.training.tasks import (
-            LanguageModelingTask, MaskedLMTask,
+            LanguageModelingTask, MaskedLMTask, MoeLanguageModelingTask,
         )
 
         train_loader = TokenLoader(train_ds, mesh, args.batch_size, shuffle=True,
@@ -143,6 +152,12 @@ def main(argv=None):
                     make_flash_attention_fn,
                 )
                 lm_kwargs["attention_fn"] = make_flash_attention_fn(causal=True)
+            elif args.attention == "ulysses":
+                from distributed_pytorch_training_tpu.ops import (
+                    make_ulysses_attention_fn,
+                )
+                lm_kwargs["attention_fn"] = make_ulysses_attention_fn(
+                    mesh, causal=True)
             else:  # ring
                 from distributed_pytorch_training_tpu.ops import (
                     make_ring_attention_fn,
@@ -153,6 +168,9 @@ def main(argv=None):
         if family == "bert":
             task = MaskedLMTask(vocab_size=train_ds.vocab_size,
                                 compute_dtype=compute_dtype)
+        elif "moe" in args.model:
+            # MoE models add the Switch router load-balancing loss
+            task = MoeLanguageModelingTask(compute_dtype=compute_dtype)
         else:
             task = LanguageModelingTask(compute_dtype=compute_dtype)
         sample_input = np.zeros((1, seq_len), np.int32)
@@ -234,6 +252,18 @@ def main(argv=None):
 
         if ckpt and (epoch + 1) % args.checkpoint_every == 0:
             ckpt.save(epoch + 1, state)
+
+        if guard.should_stop:
+            if ckpt:
+                if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
+                    ckpt.save(epoch + 1, state)
+                ckpt.wait()
+                log_main(f"Preempted: checkpointed epoch {epoch + 1}; "
+                         "relaunch with --resume to continue")
+            else:
+                log_main("Preempted: stopping (no --checkpoint-dir, "
+                         "nothing persisted beyond the metrics CSV)")
+            break
 
     if profiler:
         profiler.close()
