@@ -10,7 +10,7 @@ The reference publishes no numbers (`"published": {}`, BASELINE.json:13), so
 "AMP-vs-FP32 speedup curve" the reference's README promises but never fills
 in (README.md:31, :35).
 
-Usage: python bench.py [--model resnet18] [--batch-size 128] [--steps 30]
+Usage: python bench.py [--model resnet18] [--batch-size 2048] [--steps 20]
 """
 
 from __future__ import annotations
@@ -24,73 +24,65 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import jax
-import jax.numpy as jnp
-import numpy as np
+
+# Persistent compilation cache: bench re-runs (and driver retries) skip the
+# 20-40s XLA compile of each precision variant.
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
 
 def bench_config(model_name: str, per_device_batch: int, steps: int,
-                 bf16: bool, image_hw: int = 32, num_classes: int = 10) -> float:
-    """Compiled-step throughput (global samples/s) for one precision."""
-    from distributed_pytorch_training_tpu.models import get_model
-    from distributed_pytorch_training_tpu.parallel import build_mesh, shard_batch
-    from distributed_pytorch_training_tpu.parallel.mesh import batch_shard_count
-    from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
-    from distributed_pytorch_training_tpu.training.optim import sgd
-    from distributed_pytorch_training_tpu.training.tasks import (
-        ImageClassificationTask,
+                 bf16: bool, repeats: int = 3) -> float:
+    """Compiled-step training throughput (global samples/s), median of
+    `repeats` windows (single timings on a tunneled chip are noisy)."""
+    from distributed_pytorch_training_tpu.experiments.harness import (
+        build_image_trainer, synth_image_batch, timed_steps,
     )
-    from distributed_pytorch_training_tpu.data import CIFAR10_MEAN, CIFAR10_STD
 
-    mesh = build_mesh()
-    global_batch = per_device_batch * batch_shard_count(mesh)
-    dtype = jnp.bfloat16 if bf16 else jnp.float32
-
-    model = get_model(model_name, num_classes=num_classes, dtype=dtype)
-    task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
-                                   augment=True, compute_dtype=dtype)
-    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16))
-    tx = sgd(0.1, momentum=0.9, weight_decay=5e-4)
-    state = trainer.init_state(
-        model, np.zeros((1, image_hw, image_hw, 3), np.float32), tx,
-        jax.random.PRNGKey(0))
-
-    rng = np.random.RandomState(0)
-    batch = shard_batch({
-        "image": rng.randint(0, 256, (global_batch, image_hw, image_hw, 3)).astype(np.uint8),
-        "label": rng.randint(0, num_classes, global_batch).astype(np.int32),
-        "weight": np.ones(global_batch, np.float32),
-    }, mesh)
-    key = jax.random.PRNGKey(0)
-
-    # Warmup: compile + 3 steps.
-    for _ in range(3):
-        state, metrics = trainer._train_step(state, batch, key)
-    jax.block_until_ready(metrics["weight"])
-
+    trainer, state, mesh = build_image_trainer(jax.devices(), bf16, model_name)
+    batch, global_batch = synth_image_batch(mesh, per_device_batch)
+    _log(f"bench: compiling {model_name} bf16={bf16} b={global_batch}...")
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer._train_step(state, batch, key)
-    jax.block_until_ready(metrics["weight"])
-    dt = time.perf_counter() - t0
-    return global_batch * steps / dt
+    _, sps = timed_steps(trainer._train_step, state, batch, global_batch,
+                         steps, repeats)
+    _log(f"bench: bf16={bf16} done in {time.perf_counter() - t0:.1f}s "
+         f"({sps:.0f} samples/s)")
+    return sps
 
 
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet18")
-    p.add_argument("--batch-size", default=128, type=int)
-    p.add_argument("--steps", default=30, type=int)
+    p.add_argument("--batch-size", default=2048, type=int,
+                   help="per-device batch; 2048 saturates the chip on CIFAR "
+                        "shapes (the reference default 128 leaves it ~18x "
+                        "underutilized, mostly dispatch-bound — see "
+                        "experiments 'batch')")
+    p.add_argument("--steps", default=20, type=int)
+    p.add_argument("--repeats", default=3, type=int)
     args = p.parse_args(argv)
 
     n_chips = jax.device_count()
-    fp32 = bench_config(args.model, args.batch_size, args.steps, bf16=False)
-    bf16 = bench_config(args.model, args.batch_size, args.steps, bf16=True)
+    fp32 = bench_config(args.model, args.batch_size, args.steps, bf16=False,
+                        repeats=args.repeats)
+    bf16 = bench_config(args.model, args.batch_size, args.steps, bf16=True,
+                        repeats=args.repeats)
 
     result = {
-        "metric": f"{args.model}_cifar10_train_throughput_bf16",
+        "metric": (f"{args.model}_cifar10_train_throughput_bf16"
+                   f"_b{args.batch_size}"),
         "value": round(bf16 / n_chips, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(bf16 / fp32, 3),  # bf16-vs-fp32 speedup (AMP parity curve)
+        "per_device_batch": args.batch_size,
+        "fp32_samples_per_sec_chip": round(fp32 / n_chips, 2),
     }
     print(json.dumps(result))
 

@@ -54,9 +54,12 @@ def random_crop_flip(
     off_h = jax.random.randint(key_crop_h, (n,), 0, 2 * padding + 1)
     off_w = jax.random.randint(key_crop_w, (n,), 0, 2 * padding + 1)
 
-    def crop_one(img, oh, ow):
-        return jax.lax.dynamic_slice(img, (oh, ow, 0), (h, w, c))
-
-    cropped = jax.vmap(crop_one)(padded, off_h, off_w)
+    # Per-sample crop as ONE batched gather (advanced indexing), not a
+    # vmap'd dynamic_slice: compile time stays O(1) in batch size (the
+    # slice form made XLA compile minutes-long programs at batch >= 2048).
+    rows = off_h[:, None] + jnp.arange(h)[None, :]           # (N, h)
+    cols = off_w[:, None] + jnp.arange(w)[None, :]           # (N, w)
+    cropped = padded[jnp.arange(n)[:, None, None],
+                     rows[:, :, None], cols[:, None, :]]     # (N, h, w, C)
     flip = jax.random.bernoulli(key_flip, flip_prob, (n, 1, 1, 1))
     return jnp.where(flip, cropped[:, :, ::-1, :], cropped)

@@ -1,0 +1,79 @@
+"""Shared measurement harness for bench.py and experiments/scaling.py.
+
+One copy of the recipe (build trainer -> synthetic device batch -> warmup ->
+median-of-repeats timed steps) so the headline bench and the experiment
+tables stay comparable — the throughput-meter role of the reference
+(/root/reference/train_ddp.py:224-243), done without host syncs in the loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
+                        model_name: str = "resnet18", image_hw: int = 32,
+                        num_classes: int = 10):
+    """(trainer, state, mesh) for an image-classification config on a pure-DP
+    mesh over `devices` (the benchmark workload, BASELINE.json:8)."""
+    from ..data import CIFAR10_MEAN, CIFAR10_STD
+    from ..models import get_model
+    from ..parallel import MeshSpec, build_mesh
+    from ..training import TrainConfig, Trainer
+    from ..training.optim import sgd
+    from ..training.tasks import ImageClassificationTask
+
+    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    model = get_model(model_name, num_classes=num_classes, dtype=dtype)
+    task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
+                                   augment=True, compute_dtype=dtype)
+    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16))
+    state = trainer.init_state(
+        model, np.zeros((1, image_hw, image_hw, 3), np.float32),
+        sgd(0.1, momentum=0.9, weight_decay=5e-4), jax.random.PRNGKey(0))
+    return trainer, state, mesh
+
+
+def synth_image_batch(mesh, per_device_batch: int, image_hw: int = 32,
+                      num_classes: int = 10):
+    """(sharded_batch, global_batch): deterministic uint8 batch on the mesh."""
+    from ..parallel import shard_batch
+    from ..parallel.mesh import batch_shard_count
+
+    global_batch = per_device_batch * batch_shard_count(mesh)
+    rng = np.random.RandomState(0)
+    batch = shard_batch({
+        "image": rng.randint(0, 256, (global_batch, image_hw, image_hw, 3)
+                             ).astype(np.uint8),
+        "label": rng.randint(0, num_classes, global_batch).astype(np.int32),
+        "weight": np.ones(global_batch, np.float32),
+    }, mesh)
+    return batch, global_batch
+
+
+def timed_steps(step_fn: Callable, state, batch, global_batch: int,
+                steps: int, repeats: int = 3,
+                warmup: int = 3) -> Tuple[float, float]:
+    """Median (steps/sec, samples/sec) of `repeats` timing windows.
+
+    `step_fn(state, batch, key) -> (state, metrics)` may be a jitted function
+    or an AOT-compiled executable. Warmup covers compile + autotuning."""
+    key = jax.random.PRNGKey(0)
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch, key)
+    jax.block_until_ready(metrics["weight"])
+    rates = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch, key)
+        jax.block_until_ready(metrics["weight"])
+        rates.append(steps / (time.perf_counter() - t0))
+    sps = float(np.median(rates))
+    return sps, sps * global_batch

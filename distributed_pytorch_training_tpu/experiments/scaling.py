@@ -43,52 +43,19 @@ from ..runtime import honor_platform_env
 honor_platform_env()  # allow JAX_PLATFORMS=cpu virtual-mesh runs
 
 
-def _build_trainer(devices, bf16: bool, model_name: str = "resnet18",
-                   image_hw: int = 32, num_classes: int = 10):
-    from ..data import CIFAR10_MEAN, CIFAR10_STD
-    from ..models import get_model
-    from ..parallel import MeshSpec, build_mesh
-    from ..training import TrainConfig, Trainer
-    from ..training.optim import sgd
-    from ..training.tasks import ImageClassificationTask
-
-    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
-    dtype = jnp.bfloat16 if bf16 else jnp.float32
-    model = get_model(model_name, num_classes=num_classes, dtype=dtype)
-    task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
-                                   augment=True, compute_dtype=dtype)
-    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16))
-    state = trainer.init_state(
-        model, np.zeros((1, image_hw, image_hw, 3), np.float32),
-        sgd(0.1, momentum=0.9, weight_decay=5e-4), jax.random.PRNGKey(0))
-    return trainer, state, mesh
+# One measurement harness shared with bench.py (experiments/harness.py) so
+# the headline bench and these tables stay comparable.
+from .harness import build_image_trainer as _build_trainer  # noqa: E402
+from .harness import synth_image_batch, timed_steps  # noqa: E402
 
 
-def _timed_steps(trainer, state, mesh, per_device_batch: int, steps: int,
-                 image_hw: int = 32, num_classes: int = 10,
-                 warmup: int = 3) -> Tuple[float, float]:
-    """(steps/sec, samples/sec) for the compiled train step."""
-    from ..parallel import shard_batch
-    from ..parallel.mesh import batch_shard_count
-
-    global_batch = per_device_batch * batch_shard_count(mesh)
-    rng = np.random.RandomState(0)
-    batch = shard_batch({
-        "image": rng.randint(0, 256, (global_batch, image_hw, image_hw, 3)
-                             ).astype(np.uint8),
-        "label": rng.randint(0, num_classes, global_batch).astype(np.int32),
-        "weight": np.ones(global_batch, np.float32),
-    }, mesh)
-    key = jax.random.PRNGKey(0)
-    for _ in range(warmup):
-        state, metrics = trainer._train_step(state, batch, key)
-    jax.block_until_ready(metrics["weight"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = trainer._train_step(state, batch, key)
-    jax.block_until_ready(metrics["weight"])
-    dt = time.perf_counter() - t0
-    return steps / dt, steps * global_batch / dt
+def _measure(trainer, state, mesh, per_device_batch: int,
+             steps: int) -> Tuple[float, float]:
+    """(steps/sec, samples/sec) for the jitted train step."""
+    batch, global_batch = synth_image_batch(mesh, per_device_batch)
+    sps, samples = timed_steps(trainer._train_step, state, batch,
+                               global_batch, steps)
+    return sps, samples
 
 
 def _emit(rows: List[dict], csv_path: Optional[str]) -> None:
@@ -124,7 +91,7 @@ def run_scaling(args) -> List[dict]:
     for c in counts:
         trainer, state, mesh = _build_trainer(devices[:c], args.bf16,
                                               args.model)
-        _, sps = _timed_steps(trainer, state, mesh, args.batch_size,
+        _, sps = _measure(trainer, state, mesh, args.batch_size,
                               args.steps)
         base = base or sps
         rows.append({
@@ -141,7 +108,7 @@ def run_batch_sweep(args) -> List[dict]:
     rows = []
     for b in (32, 64, 128, 256, 512):
         trainer, state, mesh = _build_trainer(devices, args.bf16, args.model)
-        _, sps = _timed_steps(trainer, state, mesh, b, args.steps)
+        _, sps = _measure(trainer, state, mesh, b, args.steps)
         rows.append({"per_device_batch": b,
                      "global_samples_per_s": round(sps, 1)})
     return rows
@@ -153,7 +120,7 @@ def run_amp(args) -> List[dict]:
     sps_by_prec = {}
     for bf16 in (False, True):
         trainer, state, mesh = _build_trainer(devices, bf16, args.model)
-        _, sps = _timed_steps(trainer, state, mesh, args.batch_size,
+        _, sps = _measure(trainer, state, mesh, args.batch_size,
                               args.steps)
         sps_by_prec[bf16] = sps
         rows.append({"precision": "bf16" if bf16 else "fp32",
@@ -164,9 +131,13 @@ def run_amp(args) -> List[dict]:
     return rows
 
 
+# HLO text: `%name = shape op-name(...)`. On TPU the latency-hiding scheduler
+# splits collectives into async `-start`/`-done` pairs; count the `-start`
+# half (and bare sync forms), never `-done`, so each collective counts once.
 _COLLECTIVE_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"[.\w]*\s*=\s*(\([^)]*\)|\S+)")
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start|-done)?[.\w]*\(")
 
 
 def collective_census(compiled_text: str) -> List[dict]:
@@ -177,8 +148,9 @@ def collective_census(compiled_text: str) -> List[dict]:
     the reference's promised profiler-timeline read-off (README.md:35)."""
     rows = {}
     for m in _COLLECTIVE_RE.finditer(compiled_text):
-        kind = m.group(1)
-        shape = m.group(2)
+        shape, kind, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue  # the paired completion of an async -start
         key = (kind, shape)
         if key not in rows:
             rows[key] = {"op": kind, "result_shape": shape, "count": 0}
@@ -193,12 +165,12 @@ def run_gradsync(args) -> List[dict]:
 
     # (a) measured: constant per-device batch, 1 chip vs N chips
     trainer1, state1, mesh1 = _build_trainer(devices[:1], args.bf16, args.model)
-    step1, _ = _timed_steps(trainer1, state1, mesh1, args.batch_size, args.steps)
+    step1, _ = _measure(trainer1, state1, mesh1, args.batch_size, args.steps)
     t1 = 1.0 / step1
     rows.append({"measurement": "step_time_1chip_ms", "value": round(t1 * 1e3, 3)})
     if n > 1:
         trainerN, stateN, meshN = _build_trainer(devices, args.bf16, args.model)
-        stepN, _ = _timed_steps(trainerN, stateN, meshN, args.batch_size,
+        stepN, _ = _measure(trainerN, stateN, meshN, args.batch_size,
                                 args.steps)
         tN = 1.0 / stepN
         share = max(0.0, 1.0 - t1 / tN)
