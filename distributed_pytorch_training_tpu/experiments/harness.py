@@ -67,7 +67,8 @@ def timed_steps(step_fn: Callable, state, batch, global_batch: int,
     key = jax.random.PRNGKey(0)
     for _ in range(warmup):
         state, metrics = step_fn(state, batch, key)
-    jax.block_until_ready(metrics["weight"])
+    if warmup:  # warmup=0 leaves `metrics` unbound; nothing to wait on
+        jax.block_until_ready(metrics["weight"])
     rates = []
     for _ in range(repeats):
         t0 = time.perf_counter()

@@ -170,16 +170,12 @@ def run_gradsync(args) -> List[dict]:
     rows.append({"measurement": "step_time_1chip_ms", "value": round(t1 * 1e3, 3)})
     if n > 1:
         trainerN, stateN, meshN = _build_trainer(devices, args.bf16, args.model)
-        stepN, _ = _measure(trainerN, stateN, meshN, args.batch_size,
-                                args.steps)
-        tN = 1.0 / stepN
-        share = max(0.0, 1.0 - t1 / tN)
-        rows.append({"measurement": f"step_time_{n}chip_ms",
-                     "value": round(tN * 1e3, 3)})
-        rows.append({"measurement": "grad_sync_share_pct",
-                     "value": round(100.0 * share, 1)})
 
-        # (b) static: collective census of the compiled N-chip step
+        # (b) static: collective census of the compiled N-chip step.
+        # Lower/compile BEFORE the timed run: _measure runs the donating
+        # jitted step on stateN, after which its buffers are deleted on
+        # backends that honor donation (TPU) — lowering afterwards would
+        # depend on donated-away state (ADVICE r1).
         from ..parallel import shard_batch
         from ..parallel.mesh import batch_shard_count
 
@@ -192,6 +188,16 @@ def run_gradsync(args) -> List[dict]:
         }, meshN)
         compiled = trainerN._train_step.lower(
             stateN, batch, jax.random.PRNGKey(0)).compile()
+
+        stepN, _ = _measure(trainerN, stateN, meshN, args.batch_size,
+                                args.steps)
+        tN = 1.0 / stepN
+        share = max(0.0, 1.0 - t1 / tN)
+        rows.append({"measurement": f"step_time_{n}chip_ms",
+                     "value": round(tN * 1e3, 3)})
+        rows.append({"measurement": "grad_sync_share_pct",
+                     "value": round(100.0 * share, 1)})
+
         census = collective_census(compiled.as_text())
         print("\nCollective ops in the compiled train step "
               "(the DDP reducer's all-reduces, as XLA scheduled them):")

@@ -48,12 +48,14 @@ class MoeMlp(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         # GShard-style GROUP-WISE dispatch: each batch row is a routing group
-        # with its own capacity ceil(S/E * cf). Dispatch/combine tensors are
+        # with its own capacity ceil(S*k/E * cf). Dispatch/combine tensors are
         # (B, S, E, C) — linear in total token count (a global-N capacity
         # would make them quadratic and OOM at real batch x seq sizes).
+        # Capacity scales with top_k: k assignments are made per token, so
+        # total slots must cover S*k routing decisions, not S.
         b, s, d = x.shape
         e = self.num_experts
-        cap = max(1, int(np.ceil(s / e * self.capacity_factor)))
+        cap = max(1, int(np.ceil(s * self.top_k / e * self.capacity_factor)))
 
         router = nn.Dense(e, use_bias=False, dtype=jnp.float32,
                           param_dtype=self.param_dtype, name="router")
@@ -129,6 +131,7 @@ class MoeTransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     layernorm_epsilon: float = 1e-5
     attention_fn: Optional[Callable] = None
+    router_noise: float = 0.0
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True):
@@ -148,6 +151,7 @@ class MoeTransformerBlock(nn.Module):
         y = MoeMlp(num_experts=self.num_experts, hidden_dim=self.mlp_dim,
                    top_k=self.top_k, capacity_factor=self.capacity_factor,
                    dtype=self.dtype, param_dtype=self.param_dtype,
+                   router_noise=self.router_noise,
                    name="moe")(y, deterministic=deterministic)
         return x + y
 
@@ -169,6 +173,7 @@ class GPT2MoELMHead(nn.Module):
     param_dtype: Dtype = jnp.float32
     layernorm_epsilon: float = 1e-5
     attention_fn: Optional[Callable] = None
+    router_noise: float = 0.0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = False):
@@ -200,6 +205,7 @@ class GPT2MoELMHead(nn.Module):
                     param_dtype=self.param_dtype,
                     layernorm_epsilon=self.layernorm_epsilon,
                     attention_fn=self.attention_fn,
+                    router_noise=self.router_noise,
                     name=f"block{i}")(x, mask=mask, deterministic=not train)
             else:
                 x = TransformerBlock(

@@ -22,6 +22,10 @@ from .mesh import BATCH_AXES
 
 logger = logging.getLogger(__name__)
 
+# Degraded layouts warned about already (one warning per unique shape/spec —
+# rule tables hit the same shapes for params+optimizer state repeatedly).
+_degraded_warned: set = set()
+
 
 class PartitionRules:
     """Ordered (regex, PartitionSpec) table; first match on the '/'-joined
@@ -104,8 +108,15 @@ def feasible_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
         else:
             entries.append(entry)
     if changed:
-        logger.debug("sharding degraded to %s for shape %s (indivisible)",
-                     entries, shape)
+        # Warn (once per shape/spec) — a silently-replicated tensor the rules
+        # meant to split multiplies per-device memory and hides rule bugs.
+        key = (tuple(spec), shape, tuple(sorted(mesh.shape.items())))
+        if key not in _degraded_warned:
+            _degraded_warned.add(key)
+            logger.warning(
+                "sharding %s infeasible for shape %s (indivisible dims) — "
+                "degraded to %s (replicating those dims)",
+                spec, shape, P(*entries))
     return P(*entries)
 
 

@@ -109,8 +109,13 @@ class LanguageModelingTask(Task):
 
     def loss_and_metrics(self, state, params, batch, rng, train):
         ids = batch["input_ids"]
+        # Thread the step rng into apply so stochastic model internals
+        # (dropout, MoE router jitter — models/moe.py router_noise) have the
+        # "dropout" stream available at train time.
+        rngs = {"dropout": rng} if train else None
         logits, mutated = state.apply_fn(
-            {"params": params}, ids, train=train, mutable=["losses"])
+            {"params": params}, ids, train=train, mutable=["losses"],
+            rngs=rngs)
         # shift: predict ids[:, 1:] from logits[:, :-1]
         tgt = ids[:, 1:]
         lg = logits[:, :-1].astype(jnp.float32)
@@ -165,7 +170,9 @@ class MaskedLMTask(Task):
                                      ids))
         inputs = jnp.where(selected, masked, ids)
 
-        logits = state.apply_fn({"params": params}, inputs, train=train)
+        rngs = {"dropout": jax.random.fold_in(rng, 1)} if train else None
+        logits = state.apply_fn({"params": params}, inputs, train=train,
+                                rngs=rngs)
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits.astype(jnp.float32), ids)
         w = selected.astype(jnp.float32) * batch["weight"][:, None]

@@ -55,6 +55,54 @@ def test_aux_loss_sown_and_finite():
     assert np.isfinite(float(aux)) and 0.5 < float(aux) < 4.0
 
 
+def test_capacity_scales_with_top_k():
+    """top_k=2 doubles the routing assignments, so capacity must scale by k
+    (ADVICE r1: the old ceil(S/E*cf) covered only ~62% of 2S assignments).
+
+    With E=2 and top_k=2 every token routes to BOTH experts — each expert
+    gets exactly S assignments. Correct capacity at cf=1.0 is S (no drops),
+    so the output must equal the ample-capacity (cf=4.0) reference; the old
+    S/E formula gave cap=S/2 and dropped half the assignments."""
+    s, e = 16, 2
+    x = jnp.asarray(np.random.RandomState(3).randn(1, s, 8), jnp.float32)
+    tight = MoeMlp(num_experts=e, hidden_dim=16, top_k=2, capacity_factor=1.0)
+    ample = MoeMlp(num_experts=e, hidden_dim=16, top_k=2, capacity_factor=4.0)
+    params = tight.init(jax.random.PRNGKey(0), x)["params"]
+    y_tight = np.asarray(tight.apply({"params": params}, x))
+    y_ample = np.asarray(ample.apply({"params": params}, x))
+    np.testing.assert_allclose(y_tight, y_ample, rtol=1e-5, atol=1e-6)
+
+
+def test_router_noise_trains_through_lm_task():
+    """router_noise > 0 at train time must not raise (ADVICE r1: the task
+    previously omitted the rngs dict, so make_rng('dropout') failed) and must
+    actually jitter routing across rng keys."""
+    from distributed_pytorch_training_tpu.training.tasks import (
+        MoeLanguageModelingTask,
+    )
+    from distributed_pytorch_training_tpu.training.train_state import TrainState
+    from distributed_pytorch_training_tpu.training.optim import sgd
+
+    model = get_model("gpt2_moe", vocab_size=64, hidden_dim=16, depth=2,
+                      num_heads=2, num_experts=4, max_position=16,
+                      router_noise=0.5)
+    ids = np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids),
+                           train=False)
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"], tx=sgd(0.1))
+    task = MoeLanguageModelingTask()
+    batch = {"input_ids": jnp.asarray(ids),
+             "weight": jnp.ones(2, jnp.float32)}
+    loss1, _ = task.loss_and_metrics(state, state.params, batch,
+                                     jax.random.PRNGKey(1), train=True)
+    loss2, _ = task.loss_and_metrics(state, state.params, batch,
+                                     jax.random.PRNGKey(2), train=True)
+    assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
+    # different rng -> different router jitter -> (generically) different loss
+    assert float(loss1) != float(loss2)
+
+
 def test_gpt2_moe_forward_and_registry():
     model = get_model("gpt2_moe", vocab_size=128, hidden_dim=32, depth=2,
                       num_heads=2, num_experts=4, max_position=32)
