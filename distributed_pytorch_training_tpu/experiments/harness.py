@@ -1,15 +1,26 @@
 """Shared measurement harness for bench.py and experiments/scaling.py.
 
 One copy of the recipe (build trainer -> synthetic device batch -> warmup ->
-median-of-repeats timed steps) so the headline bench and the experiment
-tables stay comparable — the throughput-meter role of the reference
+timed windows) so the headline bench and the experiment tables stay
+comparable — the throughput-meter role of the reference
 (/root/reference/train_ddp.py:224-243), done without host syncs in the loop.
+
+Timing methodology (important): the synchronization point is a **value
+fetch** (`jax.device_get` of a step output), not `block_until_ready`. On the
+tunneled bench backend `block_until_ready` can return before execution
+finishes, which inflated a round-2 measurement to 484 TFLOP/s on a
+197 TFLOP/s chip. A value fetch cannot lie — the bytes must exist — but it
+carries a constant round-trip cost, so the rate is computed by **window
+differencing**: time T(k) for k steps and T(2k) for 2k steps (each
+fetch-synced) and report k / (T(2k) - T(k)). Constant per-window overhead
+(tunnel RTT, dispatch, fetch) cancels exactly. Windows auto-grow until the
+differenced time is large enough to trust.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +51,30 @@ def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
     return trainer, state, mesh
 
 
+def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
+                     model_name: str, seq_len: int):
+    """(trainer, state, mesh) for a language-model config (gpt2_*/bert_base,
+    BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes."""
+    from ..models import get_model
+    from ..parallel import MeshSpec, build_mesh
+    from ..training import TrainConfig, Trainer
+    from ..training.optim import adamw
+    from ..training.tasks import LanguageModelingTask, MaskedLMTask
+
+    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    model = get_model(model_name, dtype=dtype, max_position=max(seq_len, 512))
+    if model_name.startswith("bert"):
+        task = MaskedLMTask(compute_dtype=dtype)
+    else:
+        task = LanguageModelingTask(compute_dtype=dtype)
+    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16),
+                      rules=type(model).partition_rules())
+    state = trainer.init_state(model, np.zeros((1, seq_len), np.int32),
+                               adamw(1e-4), jax.random.PRNGKey(0))
+    return trainer, state, mesh
+
+
 def synth_image_batch(mesh, per_device_batch: int, image_hw: int = 32,
                       num_classes: int = 10):
     """(sharded_batch, global_batch): deterministic uint8 batch on the mesh."""
@@ -57,24 +92,195 @@ def synth_image_batch(mesh, per_device_batch: int, image_hw: int = 32,
     return batch, global_batch
 
 
+def synth_token_batch(mesh, per_device_batch: int, seq_len: int,
+                      vocab_size: int = 50257):
+    """(sharded_batch, global_batch): deterministic token batch on the mesh."""
+    from ..parallel import shard_batch
+    from ..parallel.mesh import batch_shard_count
+
+    global_batch = per_device_batch * batch_shard_count(mesh)
+    rng = np.random.RandomState(0)
+    batch = shard_batch({
+        "input_ids": rng.randint(0, vocab_size,
+                                 (global_batch, seq_len)).astype(np.int32),
+        "weight": np.ones(global_batch, np.float32),
+    }, mesh)
+    return batch, global_batch
+
+
+def _fetch(metrics) -> float:
+    """True completion sync: pull a step-output VALUE to the host. Unlike
+    block_until_ready this cannot return before the program has executed."""
+    return float(jax.device_get(metrics["weight"]))
+
+
+def _run_window(step_fn: Callable, state, batch, key, n: int):
+    """Dispatch n steps and fetch-sync; returns (state, wall seconds)."""
+    t0 = time.perf_counter()
+    metrics = None
+    for _ in range(n):
+        state, metrics = step_fn(state, batch, key)
+    if metrics is not None:
+        _fetch(metrics)
+    return state, time.perf_counter() - t0
+
+
 def timed_steps(step_fn: Callable, state, batch, global_batch: int,
-                steps: int, repeats: int = 3,
-                warmup: int = 3) -> Tuple[float, float]:
-    """Median (steps/sec, samples/sec) of `repeats` timing windows.
+                steps: int, repeats: int = 3, warmup: int = 3,
+                min_window_s: float = 0.5,
+                max_steps: int = 2048) -> Tuple[float, float]:
+    """Median (steps/sec, samples/sec) over `repeats` differenced windows.
 
     `step_fn(state, batch, key) -> (state, metrics)` may be a jitted function
-    or an AOT-compiled executable. Warmup covers compile + autotuning."""
+    or an AOT-compiled executable. Warmup covers compile + autotuning. Each
+    repeat measures T(steps) and T(2*steps) and reports
+    steps / (T(2*steps) - T(steps)) — constant sync overhead cancels. If the
+    differenced time is below `min_window_s`, the window doubles (up to
+    `max_steps`) so tunnel-latency noise cannot dominate the rate.
+    """
+    from .flops import MeasurementError
+
     key = jax.random.PRNGKey(0)
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):
         state, metrics = step_fn(state, batch, key)
-    if warmup:  # warmup=0 leaves `metrics` unbound; nothing to wait on
-        jax.block_until_ready(metrics["weight"])
-    rates = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step_fn(state, batch, key)
-        jax.block_until_ready(metrics["weight"])
-        rates.append(steps / (time.perf_counter() - t0))
+    _fetch(metrics)
+
+    # Auto-size the window: the differenced interval must dwarf timing noise.
+    # The break condition keeps t1/t2 from the n they were measured at — a
+    # stale-timing exit here would inflate the rate 2x.
+    n = steps
+    while True:
+        state, t1 = _run_window(step_fn, state, batch, key, n)
+        state, t2 = _run_window(step_fn, state, batch, key, 2 * n)
+        if t2 - t1 >= min_window_s or 2 * n >= max_steps:
+            break
+        n *= 2
+
+    # A non-positive (or tiny) differenced interval means overhead variance
+    # swamped the n-step work — that window is NOISE, not a rate. Publishing
+    # n/epsilon would be the impossible-throughput failure class this
+    # harness exists to prevent, so bad windows are retried and a window
+    # budget exhausted is a loud MeasurementError, never a number.
+    floor = max(1e-4, 0.05 * min_window_s)
+    rates: list = []
+    bad = 0
+    if t2 - t1 >= floor:
+        rates.append(n / (t2 - t1))
+    else:
+        bad += 1
+    while len(rates) < repeats and bad < repeats + 3:
+        state, t1 = _run_window(step_fn, state, batch, key, n)
+        state, t2 = _run_window(step_fn, state, batch, key, 2 * n)
+        if t2 - t1 >= floor:
+            rates.append(n / (t2 - t1))
+        else:
+            bad += 1
+    if not rates:
+        raise MeasurementError(
+            f"timing windows of {n}..{2 * n} steps produced no positive "
+            f"differenced interval (last T(2n)-T(n) = {t2 - t1:.4f}s) — "
+            "backend timing is too noisy to report a throughput")
     sps = float(np.median(rates))
     return sps, sps * global_batch
+
+
+def measure_config(model_name: str, per_device_batch: int, steps: int,
+                   bf16: bool, repeats: int = 3, seq_len: int = 512,
+                   devices: Optional[Sequence[jax.Device]] = None,
+                   true_fp32: bool = True, min_window_s: float = 0.5) -> dict:
+    """Full self-verifying measurement of one training config.
+
+    Returns a dict with samples/s, FLOPs from XLA cost analysis AND the
+    analytic jaxpr matmul/conv model, the detected chip peak, and mfu_pct.
+    Raises flops.MeasurementError if the implied FLOP/s exceeds the chip peak
+    (a broken measurement must never be reported as a result).
+
+    When ``bf16=False`` and ``true_fp32``, the whole config is traced under
+    ``jax.default_matmul_precision("highest")`` so the fp32 arm really runs
+    fp32 matmul passes — without this, TPU "fp32" matmuls default to bf16 MXU
+    passes and an AMP comparison measures nothing (the reference's AMP-vs-FP32
+    experiment, /root/reference/README.md:31).
+    """
+    import contextlib
+
+    from . import flops as flops_mod
+
+    devices = list(devices) if devices is not None else jax.devices()
+    is_lm = model_name.startswith(("gpt2", "bert"))
+
+    ctx = (jax.default_matmul_precision("highest")
+           if (not bf16 and true_fp32) else contextlib.nullcontext())
+    with ctx:
+        if is_lm:
+            trainer, state, mesh = build_lm_trainer(devices, bf16, model_name,
+                                                    seq_len)
+            vocab = 30522 if model_name.startswith("bert") else 50257
+            batch, global_batch = synth_token_batch(mesh, per_device_batch,
+                                                    seq_len, vocab)
+        else:
+            trainer, state, mesh = build_image_trainer(devices, bf16,
+                                                       model_name)
+            batch, global_batch = synth_image_batch(mesh, per_device_batch)
+
+        key = jax.random.PRNGKey(0)
+        # AOT-compile once: cost analysis reads the exact executable we time.
+        compiled = trainer._train_step.lower(state, batch, key).compile()
+
+        xla_flops = flops_mod.xla_flops_per_step(compiled)
+        analytic_fwd = flops_mod.jaxpr_matmul_flops(
+            lambda s, b: trainer.task.loss_and_metrics(
+                s, s.params, b, key, train=True)[0], state, batch)
+
+        sps, samples_per_s = timed_steps(compiled, state, batch, global_batch,
+                                         steps, repeats,
+                                         min_window_s=min_window_s)
+
+    n_dev = len(devices)
+    peak = flops_mod.chip_peak_tflops(devices[0])
+    # MFU numerator: the analytic matmul/conv model (FMA = 2 FLOPs — the
+    # convention the chip-peak tables use). XLA's cost analysis is the
+    # cross-check: it counts the compiled executable but uses FMA = 1 and
+    # skips custom-call lowerings, so it should land within ~[0.25x, 1.5x]
+    # of the analytic count, not be the headline.
+    step_flops = 3.0 * analytic_fwd if analytic_fwd else xla_flops
+    crosscheck_warning = None
+    if xla_flops and analytic_fwd:
+        ratio = xla_flops / (3.0 * analytic_fwd)
+        if not (0.2 <= ratio <= 2.0):
+            crosscheck_warning = (
+                f"XLA cost analysis ({xla_flops:.3g}) vs analytic 3x-forward "
+                f"({3.0 * analytic_fwd:.3g}) disagree by {ratio:.2f}x — one "
+                "FLOPs instrument is miscounting this model")
+    ctx_str = (f"{model_name} b={per_device_batch} on "
+               f"{n_dev}x {devices[0].device_kind}")
+    mfu = flops_mod.mfu_pct(step_flops, sps, peak * n_dev if peak else None)
+    # Validate BOTH instruments: if either implies >peak the measurement is
+    # broken, even when the headline instrument happens to undercount.
+    warning = flops_mod.check_mfu(mfu, context=ctx_str)
+    flops_mod.check_mfu(
+        flops_mod.mfu_pct(xla_flops, sps, peak * n_dev if peak else None),
+        context=ctx_str + " (XLA cost-analysis instrument)")
+
+    result = {
+        "model": model_name,
+        "bf16": bf16,
+        "per_device_batch": per_device_batch,
+        "global_batch": global_batch,
+        "steps_per_sec": round(sps, 4),
+        "samples_per_sec": round(samples_per_s, 2),
+        "samples_per_sec_chip": round(samples_per_s / n_dev, 2),
+        "flops_per_step_xla": xla_flops,
+        "flops_per_step_analytic3x": 3.0 * analytic_fwd,
+        "tflops_per_sec": (round(step_flops * sps / 1e12, 2)
+                           if step_flops else None),
+        "chip_peak_tflops_bf16": peak,
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+    }
+    if is_lm:
+        result["seq_len"] = seq_len
+        result["tokens_per_sec"] = round(samples_per_s * seq_len, 1)
+    if warning:
+        result["mfu_warning"] = warning
+    if crosscheck_warning:
+        result["flops_crosscheck_warning"] = crosscheck_warning
+    return result

@@ -50,11 +50,13 @@ from .harness import synth_image_batch, timed_steps  # noqa: E402
 
 
 def _measure(trainer, state, mesh, per_device_batch: int,
-             steps: int) -> Tuple[float, float]:
+             steps: int, repeats: int = 3,
+             min_window_s: float = 0.5) -> Tuple[float, float]:
     """(steps/sec, samples/sec) for the jitted train step."""
     batch, global_batch = synth_image_batch(mesh, per_device_batch)
     sps, samples = timed_steps(trainer._train_step, state, batch,
-                               global_batch, steps)
+                               global_batch, steps, repeats=repeats,
+                               min_window_s=min_window_s)
     return sps, samples
 
 
@@ -92,7 +94,7 @@ def run_scaling(args) -> List[dict]:
         trainer, state, mesh = _build_trainer(devices[:c], args.bf16,
                                               args.model)
         _, sps = _measure(trainer, state, mesh, args.batch_size,
-                              args.steps)
+                              args.steps, args.repeats, args.min_window_s)
         base = base or sps
         rows.append({
             "chips": c,
@@ -106,9 +108,12 @@ def run_scaling(args) -> List[dict]:
 def run_batch_sweep(args) -> List[dict]:
     devices = jax.devices()
     rows = []
-    for b in (32, 64, 128, 256, 512):
+    batches = (tuple(int(b) for b in args.batch_list.split(","))
+               if args.batch_list else (32, 64, 128, 256, 512))
+    for b in batches:
         trainer, state, mesh = _build_trainer(devices, args.bf16, args.model)
-        _, sps = _measure(trainer, state, mesh, b, args.steps)
+        _, sps = _measure(trainer, state, mesh, b, args.steps, args.repeats,
+                          args.min_window_s)
         rows.append({"per_device_batch": b,
                      "global_samples_per_s": round(sps, 1)})
     return rows
@@ -121,7 +126,7 @@ def run_amp(args) -> List[dict]:
     for bf16 in (False, True):
         trainer, state, mesh = _build_trainer(devices, bf16, args.model)
         _, sps = _measure(trainer, state, mesh, args.batch_size,
-                              args.steps)
+                              args.steps, args.repeats, args.min_window_s)
         sps_by_prec[bf16] = sps
         rows.append({"precision": "bf16" if bf16 else "fp32",
                      "global_samples_per_s": round(sps, 1)})
@@ -165,7 +170,8 @@ def run_gradsync(args) -> List[dict]:
 
     # (a) measured: constant per-device batch, 1 chip vs N chips
     trainer1, state1, mesh1 = _build_trainer(devices[:1], args.bf16, args.model)
-    step1, _ = _measure(trainer1, state1, mesh1, args.batch_size, args.steps)
+    step1, _ = _measure(trainer1, state1, mesh1, args.batch_size, args.steps,
+                          args.repeats, args.min_window_s)
     t1 = 1.0 / step1
     rows.append({"measurement": "step_time_1chip_ms", "value": round(t1 * 1e3, 3)})
     if n > 1:
@@ -190,13 +196,39 @@ def run_gradsync(args) -> List[dict]:
             stateN, batch, jax.random.PRNGKey(0)).compile()
 
         stepN, _ = _measure(trainerN, stateN, meshN, args.batch_size,
-                                args.steps)
+                                args.steps, args.repeats, args.min_window_s)
         tN = 1.0 / stepN
         share = max(0.0, 1.0 - t1 / tN)
         rows.append({"measurement": f"step_time_{n}chip_ms",
                      "value": round(tN * 1e3, 3)})
-        rows.append({"measurement": "grad_sync_share_pct",
+        rows.append({"measurement": "grad_sync_share_1vsN_pct",
                      "value": round(100.0 * share, 1)})
+
+        # (c) trace-derived: the jax.profiler timeline read-off the README
+        # placeholder calls for (README.md:35). Fresh state: _measure donated
+        # stateN's buffers.
+        import tempfile
+
+        from .trace_analysis import capture_step_trace, collective_share
+
+        trainerT, stateT, meshT = _build_trainer(devices, args.bf16,
+                                                 args.model)
+        batchT, _ = synth_image_batch(meshT, args.batch_size)
+        keyT = jax.random.PRNGKey(0)
+        stateT, _ = trainerT._train_step(stateT, batchT, keyT)  # warmup
+        with tempfile.TemporaryDirectory(prefix="gradsync_trace_") as td:
+            capture_step_trace(trainerT._train_step, stateT, batchT, keyT,
+                               td, steps=max(3, min(args.steps, 10)))
+            trace = collective_share(td)
+        rows.append({"measurement": "grad_sync_share_trace_pct",
+                     "value": trace["share_pct"]})
+        rows.append({"measurement": "trace_collective_ms",
+                     "value": round(trace["collective_us"] / 1e3, 3)})
+        rows.append({"measurement": "trace_xla_op_ms",
+                     "value": round(trace["op_us"] / 1e3, 3)})
+        print("\nTrace-derived collective time by op (jax.profiler):")
+        for op, us in trace["by_op"].items() or {"(none)": 0.0}.items():
+            print(f"  {op:<20} {us / 1e3:.3f} ms")
 
         census = collective_census(compiled.as_text())
         print("\nCollective ops in the compiled train step "
@@ -217,6 +249,13 @@ def main(argv=None):
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
     p.add_argument("--steps", default=20, type=int)
+    p.add_argument("--repeats", default=3, type=int)
+    p.add_argument("--min-window-s", default=0.5, type=float,
+                   help="minimum differenced timing window (lower it for "
+                        "CI smoke runs)")
+    p.add_argument("--batch-list", default=None, type=str,
+                   help="comma-separated per-device batches for the 'batch' "
+                        "sweep (default 32,64,128,256,512)")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--csv", default=None,
                    help="append rows to this CSV (plots regenerate from it)")

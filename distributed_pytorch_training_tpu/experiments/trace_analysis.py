@@ -1,0 +1,130 @@
+"""Gradient-sync share from jax.profiler traces.
+
+The reference README promises "At 4 GPUs, gradient synchronization accounts
+for ~X% of step time" but never measures it (/root/reference/README.md:35) —
+on GPU one would read an nsys/profiler timeline. The TPU equivalent: capture
+a `jax.profiler` trace of the compiled train step and sum the durations of
+collective ops (the DDP all-reduce equivalents XLA scheduled) against the
+total XLA-op busy time. This module parses the Chrome-trace JSON the profiler
+writes (`plugins/profile/<ts>/<host>.trace.json.gz`) — no tensorboard plugin
+needed.
+
+Instruments in experiments/scaling.py `gradsync`, cross-checked three ways:
+(a) measured 1-vs-N step-time delta, (b) static HLO collective census,
+(c) THIS trace-derived share (the profiler-timeline read-off the README
+   placeholder calls for).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import re
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+# Collective op names as they appear on XLA timelines (sync form, async
+# `-start` form, and CPU thunk form). `-done` events are completion markers
+# whose duration is wait-not-work; skip them like the HLO census does.
+_COLLECTIVE_RE = re.compile(
+    r"^(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(?!.*-done)")
+
+# Host-side runtime bookkeeping seen on CPU traces (no device lanes exist
+# there); everything matching these is neither compute nor communication.
+_INFRA_PREFIXES = (
+    "ThreadpoolListener", "ThunkExecutor", "Wait", "Rendezvous", "PjRt",
+    "CommonPjRt", "Handle inputs", "end:", "CreateOutputs", "Allocate",
+    "Deallocate", "BufferAlloc", "BufferFree", "MarkDonated", "python",
+    "HostCallback", "TransferTo", "TransferFrom", "CopyTo", "CopyFrom",
+    "ExecuteHelper", "Execute (", "call_location",
+)
+
+
+def _norm(name: str) -> str:
+    """'wrapped_all-reduce.3' -> 'all-reduce.3' (CPU thunks wrap op names)."""
+    return name[8:] if name.startswith("wrapped_") else name
+
+
+def load_trace(log_dir: str) -> Tuple[List[dict], Dict[int, str]]:
+    """(complete events, pid -> process name) from every trace.json.gz under
+    `log_dir` (one per host). Raises FileNotFoundError if no trace exists."""
+    paths = sorted(glob.glob(
+        str(Path(log_dir) / "**" / "*.trace.json.gz"), recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {log_dir}")
+    events: List[dict] = []
+    pids: Dict[int, str] = {}
+    for p in paths:
+        data = json.loads(gzip.open(p).read())
+        for e in data.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e.get("pid")] = e.get("args", {}).get("name", "")
+            elif e.get("ph") == "X" and e.get("dur", 0) > 0:
+                events.append(e)
+    return events, pids
+
+
+def xla_op_events(events: List[dict], pids: Dict[int, str]) -> List[dict]:
+    """The events that represent on-device XLA op execution.
+
+    TPU/GPU traces put ops on `/device:...` process lanes — use exactly
+    those. CPU traces (the test backend) run thunks on host threadpool
+    lanes, so fall back to name-based filtering of runtime bookkeeping.
+    """
+    device_pids = {pid for pid, name in pids.items() if "/device:" in name}
+    if device_pids:
+        return [e for e in events if e.get("pid") in device_pids]
+    return [e for e in events
+            if not _norm(e["name"]).startswith(_INFRA_PREFIXES)]
+
+
+def collective_share(log_dir: str) -> dict:
+    """Trace-derived gradient-sync share: collective time / XLA-op busy time.
+
+    Returns {collective_us, op_us, share_pct, by_op: {name: us}} aggregated
+    over every device lane in the capture window. `share_pct` is the
+    fraction of device busy time spent in communication — the number the
+    reference's README placeholder wants (README.md:35).
+    """
+    events, pids = load_trace(log_dir)
+    ops = xla_op_events(events, pids)
+    coll_us = 0.0
+    op_us = 0.0
+    by_op: Dict[str, float] = {}
+    for e in ops:
+        name = _norm(e["name"])
+        dur = float(e["dur"])
+        op_us += dur
+        m = _COLLECTIVE_RE.match(name)
+        if m:
+            coll_us += dur
+            key = m.group(1)
+            by_op[key] = by_op.get(key, 0.0) + dur
+    return {
+        "collective_us": round(coll_us, 1),
+        "op_us": round(op_us, 1),
+        "share_pct": round(100.0 * coll_us / op_us, 2) if op_us else 0.0,
+        "by_op": {k: round(v, 1) for k, v in sorted(by_op.items())},
+    }
+
+
+def capture_step_trace(step_fn, state, batch, key, log_dir: str,
+                       steps: int = 3):
+    """Run `steps` executions of a compiled/jitted train step under a
+    jax.profiler trace (call AFTER warmup so compile time stays out of the
+    window). Returns the final state."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        metrics = None
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch, key)
+        if metrics is not None:
+            jax.block_until_ready(metrics)
+            float(jax.device_get(metrics["weight"]))  # true completion sync
+    finally:
+        jax.profiler.stop_trace()
+    return state

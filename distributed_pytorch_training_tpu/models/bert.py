@@ -24,7 +24,7 @@ from .layers import (
     MultiHeadAttention,
     dot_product_attention,
     padding_mask,
-    tp_rules,
+    tp_fsdp_rules,
 )
 from .registry import register_model
 
@@ -117,7 +117,7 @@ class BertForMaskedLM(nn.Module):
 
     @staticmethod
     def partition_rules() -> PartitionRules:
-        return tp_rules()
+        return tp_fsdp_rules()
 
 
 @register_model("bert_base")

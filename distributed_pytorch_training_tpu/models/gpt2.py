@@ -23,7 +23,7 @@ from .layers import (
     TransformerBlock,
     causal_mask,
     dot_product_attention,
-    tp_rules,
+    tp_fsdp_rules,
 )
 from .registry import register_model
 
@@ -86,7 +86,7 @@ class GPT2LMHead(nn.Module):
 
     @staticmethod
     def partition_rules() -> PartitionRules:
-        return tp_rules()
+        return tp_fsdp_rules()
 
 
 @register_model("gpt2_355m")

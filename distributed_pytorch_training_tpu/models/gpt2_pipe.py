@@ -1,0 +1,151 @@
+"""Pipelined GPT-2: the LM driven through GPipe pipeline parallelism.
+
+The reference has no pipeline (pure DDP, SURVEY.md §2c "PP: absent"); this is
+the model-level integration of `parallel/pipeline.py` — a real transformer LM
+whose blocks execute as pipeline stages over the mesh ``pipe`` axis, trained
+with a real optimizer through the same Trainer/Task stack as every other
+model (`--mesh pipe=N` in train.py).
+
+Design (TPU-native, not a module-per-stage port):
+* all ``depth`` TransformerBlocks share one structure, so their params are
+  STACKED: each leaf has shape (n_stages, layers_per_stage, ...) with the
+  leading axis sharded over ``pipe`` (partition_rules). One program, SPMD.
+* embeddings / final LN / tied LM head live outside the pipeline and stay
+  replicated (they are the smallest params; stage-0/stage-last placement is
+  a further optimization).
+* the forward is `pipeline_apply` (lax.scan over ticks + lax.ppermute ring);
+  its autodiff produces the reverse schedule, so jax.grad of the loss just
+  works — no hand-written backward schedule.
+
+Matches the param-tree naming of models/gpt2.py `GPT2LMHead` (wte, wpe,
+block ln1/attn/ln2/mlp, ln_f) so stacked-vs-sequential parity is directly
+testable (tests/test_pipeline.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.mesh import PIPE
+from ..parallel.pipeline import pipeline_apply
+from ..parallel.sharding import PartitionRules
+from jax.sharding import PartitionSpec as P
+from .layers import TransformerBlock, causal_mask
+
+
+@dataclasses.dataclass(frozen=True)  # hashable: apply is a jit-static field
+class GPT2PipeLMHead:
+    """GPT-2 with blocks executed as a GPipe pipeline over ``mesh['pipe']``.
+
+    Not an nn.Module: the pipeline needs explicit control of the stacked
+    param layout, so this is a thin model object exposing the same
+    ``init(rng, ids, train)`` / ``apply(variables, ids, ...)`` surface the
+    Trainer consumes.
+    """
+
+    mesh: Any
+    num_microbatches: int = 2
+    vocab_size: int = 50257
+    hidden_dim: int = 1024
+    depth: int = 24
+    num_heads: int = 16
+    max_position: int = 1024
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    layernorm_epsilon: float = 1e-5
+
+    def _block(self) -> TransformerBlock:
+        return TransformerBlock(
+            num_heads=self.num_heads,
+            head_dim=self.hidden_dim // self.num_heads,
+            mlp_dim=4 * self.hidden_dim,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            layernorm_epsilon=self.layernorm_epsilon)
+
+    @property
+    def n_stages(self) -> int:
+        return self.mesh.shape[PIPE]
+
+    # -- flax-compatible surface ------------------------------------------
+
+    def init(self, rng: jax.Array, input_ids, train: bool = False) -> dict:
+        del train
+        if self.depth % self.n_stages:
+            raise ValueError(f"depth {self.depth} not divisible into "
+                             f"{self.n_stages} pipeline stages")
+        k_wte, k_wpe, k_blocks = jax.random.split(rng, 3)
+        d = self.hidden_dim
+        wte = (0.02 * jax.random.normal(k_wte, (self.vocab_size, d))
+               ).astype(self.param_dtype)
+        wpe = (0.01 * jax.random.normal(k_wpe, (self.max_position, d))
+               ).astype(self.param_dtype)
+
+        block = self._block()
+        sample = jnp.zeros((1, int(np.shape(input_ids)[-1]), d), self.dtype)
+        keys = jax.random.split(k_blocks, self.depth)
+
+        def init_one(key):
+            return block.init(key, sample, mask=None, deterministic=True
+                              )["params"]
+
+        stacked = jax.vmap(init_one)(keys)  # leaves (depth, ...)
+        # stage-major: (n_stages, depth/n_stages, ...) — axis 0 rides `pipe`
+        stage_params = jax.tree_util.tree_map(
+            lambda leaf: leaf.reshape(self.n_stages,
+                                      self.depth // self.n_stages,
+                                      *leaf.shape[1:]),
+            stacked)
+        params = {
+            "wte": {"embedding": wte},
+            "wpe": {"embedding": wpe},
+            "blocks": stage_params,
+            "ln_f": {"scale": jnp.ones((d,), self.param_dtype),
+                     "bias": jnp.zeros((d,), self.param_dtype)},
+        }
+        return {"params": params}
+
+    def apply(self, variables: dict, input_ids, train: bool = False,
+              mutable: Optional[Any] = None, rngs: Optional[dict] = None):
+        del rngs  # no dropout in the pipelined variant (rate 0)
+        params = variables["params"]
+        b, s = input_ids.shape
+        x = jnp.take(params["wte"]["embedding"], input_ids, axis=0)
+        x = x + params["wpe"]["embedding"][:s]
+        x = x.astype(self.dtype)
+
+        mask = causal_mask(s)
+        block = self._block()
+
+        def apply_layer(layer_params, h):
+            return block.apply({"params": layer_params}, h, mask=mask,
+                               deterministic=True)
+
+        x = pipeline_apply(apply_layer, params["blocks"], x, self.mesh,
+                           self.num_microbatches)
+
+        # final LN + tied head (fp32 logits, like GPT2LMHead)
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        xn = (xf - mean) * jax.lax.rsqrt(var + self.layernorm_epsilon)
+        xn = (xn * params["ln_f"]["scale"].astype(jnp.float32)
+              + params["ln_f"]["bias"].astype(jnp.float32))
+        logits = xn @ params["wte"]["embedding"].astype(jnp.float32).T
+        if mutable is not None:
+            return logits, {}
+        return logits
+
+    @staticmethod
+    def partition_rules() -> PartitionRules:
+        """Stage-stacked block leaves ride ``pipe`` on their leading axis
+        (specs shorter than the leaf rank replicate the remaining dims);
+        embeddings/LN replicate. The same table shards the optimizer
+        moments, so each stage holds only its own layers' Adam state."""
+        return PartitionRules([
+            (r"blocks/", P(PIPE)),
+        ])

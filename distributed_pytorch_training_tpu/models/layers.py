@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..parallel.mesh import MODEL
+from ..parallel.mesh import FSDP, MODEL
 from ..parallel.sharding import PartitionRules
 from jax.sharding import PartitionSpec as P
 
@@ -161,4 +161,36 @@ def tp_rules() -> PartitionRules:
         (r"mlp/fc1/bias", P(MODEL)),
         (r"mlp/fc2/kernel", P(MODEL, None)),
         (r"(token_embedding|wte)/embedding", P(MODEL, None)),
+    ])
+
+
+def tp_fsdp_rules() -> PartitionRules:
+    """The combined layout table every transformer here ships: megatron TP
+    over ``model`` on the head/neuron dim + ZeRO-style FSDP over ``fsdp`` on
+    the complementary (d_model) dim of the same kernels (SURVEY.md §2c; the
+    promise at parallel/mesh.py `fsdp` axis).
+
+    One table serves every mesh: an axis of size 1 contributes nothing, so
+    pure DP (both axes 1) reproduces the DDP replicated layout, ``--mesh
+    model=N`` is pure TP, ``--mesh fsdp=N`` is pure FSDP, and ``--mesh
+    fsdp=M,model=N`` is 2-D parameter sharding.
+
+    Because `shard_pytree` applies the same table to the optimizer state,
+    the AdamW/SGD moments are sharded identically — the ZeRO-2/3 memory win.
+    The batch is sharded over (data, fsdp) jointly (sharding.batch_spec), so
+    fsdp devices also do data-parallel work; XLA inserts the per-layer
+    all-gather (params) and reduce-scatter (grads) that a hand-written FSDP
+    wrapper would schedule manually.
+    """
+    return PartitionRules([
+        (r"attn/qkv/kernel", P(FSDP, None, MODEL, None)),
+        (r"attn/qkv/bias", P(None, MODEL, None)),
+        (r"attn/out/kernel", P(MODEL, None, FSDP)),
+        (r"mlp/fc1/kernel", P(FSDP, MODEL)),
+        (r"mlp/fc1/bias", P(MODEL)),
+        (r"mlp/fc2/kernel", P(MODEL, FSDP)),
+        (r"(token_embedding|wte)/embedding", P(MODEL, FSDP)),
+        (r"(position_embedding|wpe)/embedding", P(None, FSDP)),
+        (r"patch_embed/kernel", P(None, None, None, FSDP)),
+        (r"(head|fc|mlm_dense)/kernel", P(FSDP, None)),
     ])

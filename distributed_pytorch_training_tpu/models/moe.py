@@ -222,9 +222,9 @@ class GPT2MoELMHead(nn.Module):
 
     @staticmethod
     def partition_rules() -> PartitionRules:
-        from .layers import tp_rules
+        from .layers import tp_fsdp_rules
 
-        return tp_rules() + moe_rules()
+        return moe_rules() + tp_fsdp_rules()
 
 
 @register_model("gpt2_moe")

@@ -13,7 +13,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..parallel.sharding import PartitionRules
-from .layers import TransformerBlock, dot_product_attention, tp_rules
+from .layers import TransformerBlock, dot_product_attention, tp_fsdp_rules
 from .registry import register_model
 
 
@@ -70,7 +70,7 @@ class ViT(nn.Module):
 
     @staticmethod
     def partition_rules() -> PartitionRules:
-        return tp_rules()
+        return tp_fsdp_rules()
 
 
 @register_model("vit_b16")

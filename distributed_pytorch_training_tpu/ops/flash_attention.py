@@ -1,27 +1,40 @@
-"""Blockwise (flash) attention — Pallas TPU kernel.
+"""Blockwise (flash) attention — Pallas TPU kernels, forward AND backward.
 
 Memory-efficient attention: O(S) live memory instead of materializing the
-(S, S) score matrix, via online softmax over K/V blocks held in VMEM. This is
-the long-context building block SURVEY.md §5 requires (the reference has no
+(S, S) score matrix, via online softmax over K/V blocks. This is the
+long-context building block SURVEY.md §5 requires (the reference has no
 attention at all — ResNet on 32x32 images; the capability enters through the
 BERT-512/GPT-2 configs, BASELINE.json:11-12).
 
-Design (per pallas_guide.md):
-* grid = (batch*heads, Sq/block_q); K/V for one (batch, head) live in VMEM;
-  the kernel fori_loops over K blocks with a running (max, denom, acc) online
-  softmax in fp32; MXU matmuls via jnp.dot(..., preferred_element_type=f32).
-* causal masking skips whole K blocks past the diagonal (loop bound, not a
-  mask), masking only the diagonal block with broadcasted_iota.
-* backward: custom_vjp that recomputes attention with the XLA reference path
-  (rematerialization trades FLOPs for memory, the TPU-idiomatic default);
-  a fully-blockwise backward kernel is a further optimization.
-* on CPU backends (tests, dry-runs) the kernel runs in interpreter mode.
+Design (per pallas_guide.md; FlashAttention-2 formulation):
+
+* forward — grid (batch*heads, Sq/block_q, Sk/block_k), K block index
+  innermost so VMEM scratch accumulators (running max m, denom l, output acc)
+  carry across K iterations; ONLY one (block_q, d) + (block_k, d) tile lives
+  in VMEM at a time — full K/V never does (the r2 kernel held all of K/V per
+  (batch, head), capping sequence length at VMEM size). Emits the row
+  logsumexp for the backward. MXU matmuls via jnp.dot(...,
+  preferred_element_type=f32); softmax statistics in f32.
+* causal masking skips whole K blocks past the diagonal (pl.when on the
+  block index — no MXU work issued; the rectangular grid still walks the
+  masked steps and their tile DMAs, which overlap live blocks' compute),
+  masking only the diagonal blocks with broadcasted_iota.
+* backward — two Pallas kernels, no O(S^2) rematerialization:
+  - dK/dV: grid (..., Sk/block_k, Sq/block_q), Q innermost; for each Q block
+    regenerate p = exp(s - lse), accumulate dv += p^T dO and
+    dk += (p * (dO v^T - delta))^T q in VMEM scratch.
+  - dQ: grid (..., Sq/block_q, Sk/block_k), K innermost; accumulate
+    dq += (p * (dO v^T - delta)) k.
+  delta = rowsum(dO * O) is a cheap elementwise XLA op outside the kernels.
+  Causal variants skip fully-masked blocks entirely.
+* on CPU backends (tests, dry-runs) the kernels run in interpreter mode —
+  the S=4096 grad-parity test in tests/test_attention.py runs there.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +45,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(np.finfo(np.float32).min)
 
 
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
-    """XLA einsum attention (the recompute path for the backward pass)."""
+    """XLA einsum attention — the parity oracle for tests."""
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * sm_scale
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
@@ -43,53 +60,58 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bhst,bthd->bshd", weights, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                causal: bool, sm_scale: float):
-    # q_ref: (1, block_q, d); k_ref/v_ref: (1, Sk, d); o_ref: (1, block_q, d)
-    qb = pl.program_id(1)
-    d = q_ref.shape[-1]
-    sk = k_ref.shape[1]
-    nkb = sk // block_k
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (block_q, d)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, block_q: int, block_k: int, causal: bool, sm_scale: float):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+    nkb = pl.num_programs(2)
 
-    if causal:
-        # only K blocks intersecting the lower triangle of this Q block
-        upper = jax.lax.min(nkb, pl.cdiv((qb + 1) * block_q, block_k))
-    else:
-        upper = nkb
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (bq, bk)
+    # causal: K blocks fully above the diagonal contribute nothing
+    live = (qb * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + p.sum(axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
 
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[...] + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
-               block_q: int, block_k: int):
+def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
+                   block_q: int, block_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out (BH, Sq, d) folded back to (B, Sq, H, d), lse (BH, Sq))."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    # (B, S, H, D) -> (B*H, S, D): heads become independent grid rows.
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -101,21 +123,190 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
             f"flash_attention: seq lengths ({sq}, {sk}) must be divisible by "
             f"block sizes ({block_q}, {block_k})")
 
-    grid = (b * h, sq // block_q)
-    out = pl.pallas_call(
+    grid = (b * h, sq // block_q, sk // block_k)
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, sm_scale=sm_scale),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        interpret=(jax.default_backend() == "cpu"),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, block_q: int, block_k: int, causal: bool,
+                    sm_scale: float):
+    kb, qb = pl.program_id(1), pl.program_id(2)
+    nqb = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (qb * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)                  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)                # (bq, d)
+        lse = lse_ref[0][:, None]                         # (bq, 1)
+        delta = delta_ref[0][:, None]                     # (bq, 1)
+        s = sm_scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        dv_scr[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qb == nqb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr,
+                   *, block_q: int, block_k: int, causal: bool,
+                   sm_scale: float):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+    nkb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (qb * block_q + block_q - 1 >= kb * block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = sm_scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
+               block_q: int, block_k: int):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dof = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    of = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, j, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, j))
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, sm_scale=sm_scale),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        grid=(b * h, sk // block_k, sq // block_q),
+        in_specs=[
+            q_spec,                                               # q by j
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            q_spec,                                               # dO by j
+            row_spec,                                             # lse by j
+            row_spec,                                             # delta by j
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )
+    dk, dv = dkv(qf, kf, vf, dof, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -130,23 +321,20 @@ def flash_attention(
 ) -> jnp.ndarray:
     """Blockwise attention; numerically equivalent to softmax(QK^T*scale)V."""
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k)
+    return out
 
 
 def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _vjp_bwd(causal, sm_scale, block_q, block_k, residuals, g):
-    q, k, v = residuals
+    q, k, v, out, lse = residuals
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    # Rematerialize through the XLA reference path (same math, O(S^2) scores
-    # regenerated rather than stored — the jax.checkpoint idiom).
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

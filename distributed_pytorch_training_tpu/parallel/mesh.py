@@ -25,6 +25,7 @@ communication-hungry axes (model, seq) go last.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 from typing import Optional, Sequence
 
@@ -140,6 +141,58 @@ def build_mesh(
         # Non-TPU backends (CPU test meshes) or odd shapes: plain reshape.
         dev_array = np.asarray(list(devices)).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
+
+
+def validate_mesh_usage(
+    mesh: Mesh,
+    *,
+    rules=None,
+    attention: str = "xla",
+    is_moe: bool = False,
+    pipelined: bool = False,
+) -> None:
+    """Reject meshes with axes the selected config cannot use.
+
+    The reference cannot express this failure mode (DDP's world is one flat
+    axis), but here ``--mesh pipe=2`` with a non-pipelined model would
+    replicate all work across half the devices with no warning — devices
+    silently wasted. Each check names the flag combination that would
+    actually use the axis.
+
+    ``rules`` is the model's PartitionRules (or None); an axis is "usable"
+    for params only if some rule can place a dim on it.
+    """
+    rule_axes = rules.axes_used() if rules is not None else set()
+    problems = []
+    if mesh.shape[PIPE] > 1 and not pipelined:
+        problems.append(
+            f"pipe={mesh.shape[PIPE]} but the selected model does not run "
+            "through the pipeline (use a pipelined model config, e.g. "
+            "gpt2_*_pipe, or drop the pipe axis)")
+    if mesh.shape[SEQ] > 1 and attention not in ("ring", "ulysses"):
+        problems.append(
+            f"seq={mesh.shape[SEQ]} but --attention {attention!r} does not "
+            "shard the sequence (use --attention ring or ulysses)")
+    if mesh.shape[EXPERT] > 1 and not is_moe:
+        problems.append(
+            f"expert={mesh.shape[EXPERT]} but the model has no MoE layers "
+            "(use an *_moe model or drop the expert axis)")
+    if mesh.shape[MODEL] > 1 and MODEL not in rule_axes:
+        problems.append(
+            f"model={mesh.shape[MODEL]} but the model's partition rules "
+            "never use the tensor-parallel axis (ResNets ship replicated-"
+            "only rules; transformers support TP)")
+    if problems:
+        raise ValueError(
+            "mesh axes that would silently waste devices:\n  - "
+            + "\n  - ".join(problems))
+    if mesh.shape[FSDP] > 1 and FSDP not in rule_axes:
+        # fsdp devices still do data-parallel work (batch is sharded over
+        # (data, fsdp)) so this is a degradation, not a waste — warn.
+        logging.getLogger(__name__).warning(
+            "fsdp=%d but the model's partition rules never shard params on "
+            "the fsdp axis — running as plain data parallelism (no ZeRO "
+            "memory win)", mesh.shape[FSDP])
 
 
 def batch_shard_count(mesh: Mesh) -> int:

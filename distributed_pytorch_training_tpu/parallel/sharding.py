@@ -27,6 +27,12 @@ logger = logging.getLogger(__name__)
 _degraded_warned: set = set()
 
 
+def reset_degradation_warnings() -> None:
+    """Clear the warn-once state so a new mesh/model setup warns afresh
+    (long-lived processes and tests would otherwise inherit stale state)."""
+    _degraded_warned.clear()
+
+
 class PartitionRules:
     """Ordered (regex, PartitionSpec) table; first match on the '/'-joined
     param path wins; no match -> fully replicated (the DDP default layout)."""
@@ -49,6 +55,18 @@ class PartitionRules:
         out = PartitionRules()
         out._rules = self._rules + other._rules
         return out
+
+    def axes_used(self) -> set:
+        """Mesh axis names any rule in the table can place a dim on (used by
+        mesh validation: an axis no rule mentions cannot shard a param)."""
+        axes = set()
+        for _, spec in self._rules:
+            for entry in spec:
+                if entry is None:
+                    continue
+                names = (entry,) if isinstance(entry, str) else tuple(entry)
+                axes.update(names)
+        return axes
 
 
 def _path_str(path) -> str:

@@ -5,5 +5,7 @@ from .dist import (  # noqa: F401
     cleanup_distributed,
     honor_platform_env,
     is_distributed,
+    per_process_seed,
+    set_seed,
     setup_distributed,
 )

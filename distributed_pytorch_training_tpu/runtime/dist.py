@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+from typing import Optional
 
 import jax
 
@@ -146,3 +147,39 @@ def cleanup_distributed() -> None:
     if _INITIALIZED:
         jax.distributed.shutdown()
         _INITIALIZED = False
+
+
+def per_process_seed(seed: int, process_index: Optional[int] = None) -> int:
+    """The reference's per-rank seed rule: ``seed + rank``
+    (/root/reference/train_ddp.py:76-78) — de-correlates host-side RNG streams
+    across processes (e.g. CPU-side augmentation) on purpose.
+
+    NOTE the split responsibility in the TPU design: *device-side* randomness
+    (in-jit augmentation, dropout) uses ONE shared `PRNGKey(seed)` folded with
+    the step counter — it operates on the global batch, so per-sample streams
+    are already de-correlated and must be identical across hosts for SPMD to
+    agree. *Host-side* randomness must use THIS rule, or every host would
+    produce the same "random" numbers.
+    """
+    if process_index is None:
+        process_index = jax.process_index()
+    return seed + process_index
+
+
+def set_seed(seed: int, process_index: Optional[int] = None) -> "np.random.Generator":
+    """Seed host-side RNGs with ``seed + rank`` (maps set_seed, ref :76-78).
+
+    Seeds Python's and NumPy's global generators (for any library code that
+    reaches for them) and returns a dedicated ``np.random.Generator`` for
+    framework host-side use. Device-side keys are NOT derived here — pass
+    ``jax.random.PRNGKey(seed)`` (unfolded) to the Trainer so every host
+    traces the same program with the same key.
+    """
+    import random
+
+    import numpy as np
+
+    s = per_process_seed(seed, process_index)
+    random.seed(s)
+    np.random.seed(s % (2 ** 32))
+    return np.random.default_rng(s)

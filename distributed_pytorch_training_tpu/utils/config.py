@@ -52,6 +52,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="synthetic dataset size override")
     parser.add_argument("--mesh", default="data=-1", type=str,
                         help="mesh spec, e.g. 'data=4,model=2' (default: pure DP)")
+    parser.add_argument("--microbatches", default=4, type=int,
+                        help="GPipe microbatches per step when the mesh has "
+                             "a pipe axis > 1 (bubble fraction "
+                             "(P-1)/(M+P-1))")
     parser.add_argument("--optimizer", default="sgd", type=str,
                         help="sgd | adamw")
     parser.add_argument("--seq-len", default=None, type=int,

@@ -62,6 +62,49 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
 
+
+    def test_long_context_grad_parity_s4096(self):
+        """S=4096 forward+backward through the blockwise Pallas kernels
+        (interpreter mode) vs the XLA reference — the long-context bar from
+        SURVEY.md §5. The r2 backward was an O(S^2) recompute; this exercises
+        the real dq/dk/dv kernels at a length where the (S,S) score matrix
+        (64 MB fp32 per head) would no longer be a reasonable residual."""
+        q, k, v = _rand_qkv(b=1, s=4096, h=1, d=64, seed=3)
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, None, 512, 512) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref(q, k, v, True) ** 2).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                err_msg=f"d{name} diverges at S=4096")
+
+    def test_bf16_grad_parity(self):
+        """bf16 inputs (the TPU compute dtype): kernel stats stay fp32, so
+        grads must track the fp32-stat reference within bf16 tolerance."""
+        q, k, v = _rand_qkv(b=1, s=256, h=2, d=32, seed=4)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, None, 128, 128)
+                    .astype(jnp.float32) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), True) ** 2).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(qb, kb, vb)
+        for a, b in zip(g_flash, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=0.1, atol=0.5)
+
     def test_adapter_rejects_mask(self):
         fn = make_flash_attention_fn(causal=True)
         q, k, v = _rand_qkv(s=64)

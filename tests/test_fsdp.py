@@ -1,0 +1,132 @@
+"""FSDP (ZeRO-style parameter/optimizer sharding over the ``fsdp`` axis).
+
+The reference's DDP keeps a full replica of params + optimizer state on every
+device (/root/reference/train_ddp.py:305-310, :339-344); FSDP shards both.
+These tests pin the promise at parallel/mesh.py (`fsdp` axis doc): the layout
+must actually land on the devices — params AND optimizer moments — and the
+math must be bit-comparable to the replicated layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+from distributed_pytorch_training_tpu.parallel import (
+    MeshSpec, build_mesh, shard_batch,
+)
+from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
+from distributed_pytorch_training_tpu.training.optim import adamw
+from distributed_pytorch_training_tpu.training.tasks import LanguageModelingTask
+
+SEQ = 16
+VOCAB = 64
+
+
+def _tiny_gpt2(**kw):
+    return GPT2LMHead(vocab_size=VOCAB, hidden_dim=32, depth=2, num_heads=2,
+                      max_position=SEQ, **kw)
+
+
+def _trainer(mesh, rules):
+    t = Trainer(LanguageModelingTask(), mesh, TrainConfig(seed=0), rules=rules)
+    state = t.init_state(_tiny_gpt2(), np.zeros((1, SEQ), np.int32),
+                         adamw(1e-2), jax.random.PRNGKey(0))
+    return t, state
+
+
+def _batch(mesh, n=8):
+    rng = np.random.RandomState(0)
+    return shard_batch({
+        "input_ids": rng.randint(0, VOCAB, (n, SEQ)).astype(np.int32),
+        "weight": np.ones(n, np.float32),
+    }, mesh)
+
+
+@pytest.fixture(scope="module")
+def fsdp_mesh(devices):
+    return build_mesh(MeshSpec(data=2, fsdp=4), devices=devices)
+
+
+def _leaves_with_paths(tree):
+    return [("/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                      for k in path), leaf)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(tree)]
+
+
+def test_fsdp_params_and_opt_state_actually_sharded(fsdp_mesh):
+    """`--mesh fsdp=4` must place param AND optimizer-moment shards, not
+    silently replicate (the round-1/2 advertised-but-absent gap)."""
+    _, state = _trainer(fsdp_mesh, GPT2LMHead.partition_rules())
+
+    def fsdp_sharded(pairs):
+        out = []
+        for path, leaf in pairs:
+            if not hasattr(leaf, "sharding"):
+                continue
+            spec = leaf.sharding.spec
+            flat = [a for e in spec if e is not None
+                    for a in ((e,) if isinstance(e, str) else e)]
+            if "fsdp" in flat:
+                out.append((path, leaf))
+        return out
+
+    p_sharded = fsdp_sharded(_leaves_with_paths(state.params))
+    assert len(p_sharded) >= 8, (
+        f"expected most kernels fsdp-sharded, got {[p for p, _ in p_sharded]}")
+    # the shards must really be smaller than the leaf (memory win is real)
+    for path, leaf in p_sharded:
+        shard = leaf.addressable_shards[0].data
+        assert np.prod(shard.shape) == np.prod(leaf.shape) // 4, (
+            path, shard.shape, leaf.shape)
+
+    o_sharded = fsdp_sharded(_leaves_with_paths(state.opt_state))
+    # AdamW holds mu+nu per param -> at least 2x the param hit count
+    assert len(o_sharded) >= 2 * len(p_sharded) - 4, (
+        f"optimizer moments not sharded: {[p for p, _ in o_sharded]}")
+
+
+def test_fsdp_matches_replicated_math(fsdp_mesh):
+    """Same init key: the fsdp layout must compute the same loss as the
+    replicated (DDP) layout — layout is a performance fact, not a math fact."""
+    t_rep, s_rep = _trainer(fsdp_mesh, None)
+    t_fsdp, s_fsdp = _trainer(fsdp_mesh, GPT2LMHead.partition_rules())
+    batch = _batch(fsdp_mesh)
+
+    m_rep = t_rep._eval_step(s_rep, batch)
+    m_fsdp = t_fsdp._eval_step(s_fsdp, batch)
+    np.testing.assert_allclose(float(m_rep["loss_sum"]),
+                               float(m_fsdp["loss_sum"]), rtol=2e-5)
+    np.testing.assert_allclose(float(m_rep["correct"]),
+                               float(m_fsdp["correct"]), rtol=0)
+
+
+def test_fsdp_training_step_decreases_loss(fsdp_mesh):
+    t, state = _trainer(fsdp_mesh, GPT2LMHead.partition_rules())
+    batch = _batch(fsdp_mesh)
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for _ in range(8):
+        state, metrics = t._train_step(state, batch, key)
+        losses.append(float(metrics["loss_sum"]) / float(metrics["weight"]))
+    assert losses[-1] < losses[0], losses
+    # the updated params keep their fsdp sharding across steps (jit must not
+    # silently gather them back to replicated)
+    qkv = state.params["block0"]["attn"]["qkv"]["kernel"]
+    flat = [a for e in qkv.sharding.spec if e is not None
+            for a in ((e,) if isinstance(e, str) else e)]
+    assert "fsdp" in flat, qkv.sharding
+
+
+def test_fsdp_times_tp_2d_layout(devices):
+    """fsdp=2 x model=2 x data=2: 2-D parameter sharding + DP, one mesh."""
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices)
+    _, state = _trainer(mesh, GPT2LMHead.partition_rules())
+    fc1 = state.params["block0"]["mlp"]["fc1"]["kernel"]
+    assert fc1.sharding.spec == jax.sharding.PartitionSpec("fsdp", "model")
+    shard = fc1.addressable_shards[0].data
+    assert np.prod(shard.shape) == np.prod(fc1.shape) // 4
+    t, s = _trainer(mesh, GPT2LMHead.partition_rules())
+    sN, m = t._train_step(s, _batch(mesh), jax.random.PRNGKey(1))
+    assert np.isfinite(float(m["loss_sum"]))

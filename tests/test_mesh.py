@@ -75,3 +75,60 @@ def test_mesh_spec_rejects_zero_and_negative():
         MeshSpec.parse("data=-3")
     with pytest.raises(ValueError, match=">= 1"):
         MeshSpec(data=0).resolved(8)
+
+
+class TestValidateMeshUsage:
+    """--mesh axes the config cannot use must fail loudly, not waste devices
+    (VERDICT r2 #6: `--mesh pipe=2` silently replicated all work)."""
+
+    def _mesh(self, devices, **kw):
+        from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+        return build_mesh(MeshSpec(**kw), devices=devices)
+
+    def test_pipe_without_pipeline_rejected(self, devices):
+        import pytest
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        mesh = self._mesh(devices, pipe=2, data=4)
+        with pytest.raises(ValueError, match="pipe=2"):
+            validate_mesh_usage(mesh, pipelined=False)
+        validate_mesh_usage(mesh, pipelined=True)  # and the cure works
+
+    def test_seq_without_seq_attention_rejected(self, devices):
+        import pytest
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        mesh = self._mesh(devices, seq=2, data=4)
+        with pytest.raises(ValueError, match="seq=2"):
+            validate_mesh_usage(mesh, attention="xla")
+        validate_mesh_usage(mesh, attention="ring")
+        validate_mesh_usage(mesh, attention="ulysses")
+
+    def test_expert_without_moe_rejected(self, devices):
+        import pytest
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        mesh = self._mesh(devices, expert=2, data=4)
+        with pytest.raises(ValueError, match="expert=2"):
+            validate_mesh_usage(mesh, is_moe=False)
+        validate_mesh_usage(mesh, is_moe=True)
+
+    def test_model_axis_needs_tp_rules(self, devices):
+        import pytest
+        from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+        from distributed_pytorch_training_tpu.models.resnet import ResNet
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        mesh = self._mesh(devices, model=2, data=4)
+        with pytest.raises(ValueError, match="model=2"):
+            validate_mesh_usage(mesh, rules=ResNet.partition_rules())
+        validate_mesh_usage(mesh, rules=GPT2LMHead.partition_rules())
+
+    def test_fsdp_without_fsdp_rules_warns_not_raises(self, devices, caplog):
+        import logging
+        from distributed_pytorch_training_tpu.models.resnet import ResNet
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        mesh = self._mesh(devices, fsdp=2, data=4)
+        with caplog.at_level(logging.WARNING):
+            validate_mesh_usage(mesh, rules=ResNet.partition_rules())
+        assert any("fsdp=2" in r.getMessage() for r in caplog.records)
+
+    def test_pure_dp_mesh_always_valid(self, mesh8):
+        from distributed_pytorch_training_tpu.parallel.mesh import validate_mesh_usage
+        validate_mesh_usage(mesh8)

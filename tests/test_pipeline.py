@@ -78,3 +78,87 @@ def test_single_stage_degenerates_to_scan(devices, layer_setup):
                          num_microbatches=4)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+# ---- real-model pipeline: GPT-2 through GPipe with an optimizer ----------
+# (VERDICT r2 #7: the pipeline had only an 8-wide toy Dense driver)
+
+def _pipe_gpt2(mesh, microbatches=2, depth=4):
+    from distributed_pytorch_training_tpu.models.gpt2_pipe import GPT2PipeLMHead
+    return GPT2PipeLMHead(mesh=mesh, num_microbatches=microbatches,
+                          vocab_size=64, hidden_dim=32, depth=depth,
+                          num_heads=2, max_position=16)
+
+
+def _lm_batch(mesh, n=8, seq=16, vocab=64):
+    from distributed_pytorch_training_tpu.parallel import shard_batch
+    rng = np.random.RandomState(0)
+    return shard_batch({
+        "input_ids": rng.randint(0, vocab, (n, seq)).astype(np.int32),
+        "weight": np.ones(n, np.float32),
+    }, mesh)
+
+
+def test_pipelined_gpt2_matches_sequential_gpt2(devices):
+    """Same weights -> same logits: the pipelined model restacked from a
+    plain GPT2LMHead's params must reproduce its forward exactly (up to fp
+    reassociation)."""
+    from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+
+    mesh = build_mesh(MeshSpec(pipe=2, data=4), devices=devices)
+    seq_model = GPT2LMHead(vocab_size=64, hidden_dim=32, depth=4, num_heads=2,
+                           max_position=16)
+    ids = np.asarray(_lm_batch(mesh)["input_ids"])
+    ref_vars = seq_model.init(jax.random.PRNGKey(0), ids[:1], train=False)
+    ref_logits = seq_model.apply(ref_vars, ids, train=False)
+
+    # restack block0..block3 params into the (stages, layers/stage, ...) tree
+    rp = ref_vars["params"]
+    blocks = [rp[f"block{i}"] for i in range(4)]
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *blocks)
+    stage_params = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape(2, 2, *leaf.shape[1:]), stacked)
+    pipe_model = _pipe_gpt2(mesh)
+    pipe_vars = {"params": {
+        "wte": {"embedding": rp["wte"]["embedding"]},
+        "wpe": {"embedding": rp["wpe"]["embedding"]},
+        "blocks": stage_params,
+        "ln_f": {"scale": rp["ln_f"]["scale"], "bias": rp["ln_f"]["bias"]},
+    }}
+    pipe_logits = pipe_model.apply(pipe_vars, jnp.asarray(ids), train=False)
+    np.testing.assert_allclose(np.asarray(pipe_logits),
+                               np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_training_step_decreases_loss(devices):
+    """A full TRAINING step through the pipeline: Trainer + AdamW + GPipe
+    forward/backward; loss must decrease and stage params must stay sharded
+    over `pipe`."""
+    from distributed_pytorch_training_tpu.models.gpt2_pipe import GPT2PipeLMHead
+    from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
+    from distributed_pytorch_training_tpu.training.optim import adamw
+    from distributed_pytorch_training_tpu.training.tasks import (
+        LanguageModelingTask,
+    )
+
+    mesh = build_mesh(MeshSpec(pipe=2, data=4), devices=devices)
+    model = _pipe_gpt2(mesh)
+    trainer = Trainer(LanguageModelingTask(), mesh, TrainConfig(seed=0),
+                      rules=GPT2PipeLMHead.partition_rules())
+    state = trainer.init_state(model, np.zeros((1, 16), np.int32),
+                               adamw(1e-2), jax.random.PRNGKey(0))
+
+    # stage params actually ride the pipe axis
+    qkv = state.params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec[0] == "pipe", qkv.sharding.spec
+    assert qkv.addressable_shards[0].data.shape[0] == 1  # 1 of 2 stages
+
+    batch = _lm_batch(mesh)
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for _ in range(8):
+        state, metrics = trainer._train_step(state, batch, key)
+        losses.append(float(metrics["loss_sum"]) / float(metrics["weight"]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 8

@@ -7,8 +7,9 @@ The orchestration layer — maps `main()` of the reference
     ---------                          ----
     parse_args (:315)                  utils.config.parse_args (same flags)
     setup_distributed NCCL (:318)      runtime.setup_distributed + build_mesh
-    set_seed(seed+rank) (:319)         PRNGKey(seed); per-sample randomness via
-                                       partitionable RNG on the global batch
+    set_seed(seed+rank) (:319)         runtime.set_seed (same seed+rank rule for
+                                       host RNG); device randomness from one
+                                       shared PRNGKey(seed) on the global batch
     get_dataloaders (:332)             data.ShardedLoader (pad+mask, prefetch)
     build_model + DDP wrap (:335-336)  models.get_model + shard_pytree
     criterion/optimizer/scaler (:338)  training.make_optimizer (no scaler: bf16)
@@ -36,9 +37,11 @@ from distributed_pytorch_training_tpu.data import (
 )
 from distributed_pytorch_training_tpu.models import get_model
 from distributed_pytorch_training_tpu.parallel import MeshSpec, barrier, build_mesh
-from distributed_pytorch_training_tpu.parallel.mesh import batch_shard_count
+from distributed_pytorch_training_tpu.parallel.mesh import (
+    batch_shard_count, validate_mesh_usage,
+)
 from distributed_pytorch_training_tpu.runtime import (
-    cleanup_distributed, honor_platform_env, setup_distributed,
+    cleanup_distributed, honor_platform_env, set_seed, setup_distributed,
 )
 
 honor_platform_env()  # JAX_PLATFORMS=cpu virtual-mesh runs work as expected
@@ -77,6 +80,7 @@ def main(argv=None):
     Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
 
     ctx = setup_distributed()  # ref :318
+    set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
     mesh = build_mesh(MeshSpec.parse(args.mesh))
     n_batch_shards = batch_shard_count(mesh)
     global_batch = args.batch_size * n_batch_shards
@@ -133,6 +137,7 @@ def main(argv=None):
         log_main(f"NOTE: using synthetic data ({train_ds.name}, n={len(train_ds)})")
 
     # Loaders + model + task (ref :131-148, :335-338).
+    pipelined = False
     if is_lm:
         from distributed_pytorch_training_tpu.training.tasks import (
             LanguageModelingTask, MaskedLMTask, MoeLanguageModelingTask,
@@ -164,7 +169,28 @@ def main(argv=None):
                 )
                 lm_kwargs["attention_fn"] = make_ring_attention_fn(
                     mesh, causal=True)
-        model = get_model(args.model, **lm_kwargs)
+        n_pipe = mesh.shape["pipe"]
+        if n_pipe > 1 and family == "gpt2" and "moe" not in args.model:
+            # GPipe path: blocks stage-stacked over the `pipe` axis
+            # (models/gpt2_pipe.py). Attention runs inside the stages via
+            # the XLA path; kernel attention is a per-stage concern.
+            if args.attention != "xla":
+                raise ValueError("--mesh pipe>1 uses the XLA attention path "
+                                 "inside pipeline stages; drop --attention")
+            from distributed_pytorch_training_tpu.models.gpt2_pipe import (
+                GPT2PipeLMHead,
+            )
+
+            pipelined = True
+            cfg = get_model(args.model)  # config holder for the named size
+            model = GPT2PipeLMHead(
+                mesh=mesh, num_microbatches=args.microbatches,
+                vocab_size=cfg.vocab_size, hidden_dim=cfg.hidden_dim,
+                depth=cfg.depth, num_heads=cfg.num_heads,
+                max_position=max(cfg.max_position, seq_len),
+                dtype=compute_dtype)
+        else:
+            model = get_model(args.model, **lm_kwargs)
         if family == "bert":
             task = MaskedLMTask(vocab_size=train_ds.vocab_size,
                                 compute_dtype=compute_dtype)
@@ -199,11 +225,19 @@ def main(argv=None):
     tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
                         weight_decay=args.weight_decay)
 
+    rules = (type(model).partition_rules()
+             if hasattr(type(model), "partition_rules") else None)
+    # Refuse silently-wasted devices: every mesh axis > 1 must be one the
+    # selected model/attention combination can actually use.
+    validate_mesh_usage(mesh, rules=rules,
+                        attention=args.attention if is_lm else "xla",
+                        is_moe="moe" in args.model, pipelined=pipelined)
+
     trainer = Trainer(task, mesh,
                       TrainConfig(per_device_batch=args.batch_size,
                                   print_freq=args.print_freq, seed=args.seed,
                                   bf16=args.amp),
-                      rules=type(model).partition_rules() if hasattr(type(model), "partition_rules") else None)
+                      rules=rules)
 
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
