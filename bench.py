@@ -180,9 +180,14 @@ def _bench(args):
 
     extras = []
     if headline is not None and not args.quick:
-        # The BASELINE matrix's transformer configs, single-chip step time
-        # (BASELINE.json:11-12): GPT-2 124M causal LM and BERT-base MLM @ 512.
+        # The rest of the BASELINE matrix, single-chip (BASELINE.json:9-12):
+        # ResNet-50 + ViT-B/16 on ImageNet shapes, GPT-2 124M causal LM,
+        # BERT-base MLM @ 512.
         for name, kw in (
+            ("resnet50", dict(per_device_batch=64, image_hw=224,
+                              num_classes=1000, steps=10)),
+            ("vit_b16", dict(per_device_batch=64, image_hw=224,
+                             num_classes=1000, steps=10)),
             ("gpt2_124m", dict(per_device_batch=8, seq_len=1024, steps=10)),
             ("bert_base", dict(per_device_batch=16, seq_len=512, steps=10)),
         ):

@@ -19,5 +19,6 @@ from .datasets import (  # noqa: F401
     synthetic_image_dataset,
 )
 from .augment import normalize_images, random_crop_flip  # noqa: F401
+from .download import ensure_cifar10, fetch, fetch_and_extract  # noqa: F401
 from .loader import ShardedLoader  # noqa: F401
 from .sampler import ShardedSampler  # noqa: F401

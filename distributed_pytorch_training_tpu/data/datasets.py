@@ -121,13 +121,20 @@ def get_dataset(
     synthetic: bool = False,
     synthetic_size: Optional[int] = None,
     seed: int = 0,
+    download: bool = False,
 ) -> ArrayDataset:
     """Dataset factory (maps get_dataloaders' dataset construction, ref
-    :103-119). Falls back to synthetic data when the real set is absent
-    (zero-egress environments) — loudly, via the `.synthetic` flag."""
+    :103-119). ``download=True`` fetches+verifies the archive when absent
+    (the torchvision ``download=(rank==0)`` role, ref :106 — pass True only
+    on process 0 and barrier, as train.py does). Falls back to synthetic
+    data when the real set is absent — loudly, via the `.synthetic` flag."""
     name = name.lower()
     if name == "cifar10":
         if not synthetic:
+            if download:
+                from .download import ensure_cifar10
+
+                ensure_cifar10(data_dir, download=True)
             ds = load_cifar10(data_dir, train)
             if ds is not None:
                 return ds

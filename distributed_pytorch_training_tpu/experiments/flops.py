@@ -123,6 +123,19 @@ def _jaxpr_flops(jaxpr) -> float:
         elif name == "while":
             # trip count unknown statically; count one iteration (lower bound)
             total += _jaxpr_flops(eqn.params["body_jaxpr"].jaxpr)
+        elif name == "pallas_call":
+            # the sub-jaxpr is ONE grid tile's kernel body: scale by the
+            # grid size or the kernel's matmuls vanish from the count
+            # (a flash-attention model would understate MFU by Sq*Sk/blk^2)
+            grid = ()
+            gm = eqn.params.get("grid_mapping")
+            if gm is not None:
+                grid = getattr(gm, "grid", ())
+            body = eqn.params.get("jaxpr")
+            if body is not None:
+                tile = _jaxpr_flops(getattr(body, "jaxpr", body))
+                total += tile * math.prod(int(g) for g in grid if
+                                          isinstance(g, int))
         else:
             for key in ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr"):
                 sub = eqn.params.get(key) if eqn.params else None

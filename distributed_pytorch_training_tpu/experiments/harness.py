@@ -186,6 +186,7 @@ def timed_steps(step_fn: Callable, state, batch, global_batch: int,
 
 def measure_config(model_name: str, per_device_batch: int, steps: int,
                    bf16: bool, repeats: int = 3, seq_len: int = 512,
+                   image_hw: int = 32, num_classes: int = 10,
                    devices: Optional[Sequence[jax.Device]] = None,
                    true_fp32: bool = True, min_window_s: float = 0.5) -> dict:
     """Full self-verifying measurement of one training config.
@@ -218,9 +219,12 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
             batch, global_batch = synth_token_batch(mesh, per_device_batch,
                                                     seq_len, vocab)
         else:
-            trainer, state, mesh = build_image_trainer(devices, bf16,
-                                                       model_name)
-            batch, global_batch = synth_image_batch(mesh, per_device_batch)
+            trainer, state, mesh = build_image_trainer(
+                devices, bf16, model_name, image_hw=image_hw,
+                num_classes=num_classes)
+            batch, global_batch = synth_image_batch(
+                mesh, per_device_batch, image_hw=image_hw,
+                num_classes=num_classes)
 
         key = jax.random.PRNGKey(0)
         # AOT-compile once: cost analysis reads the exact executable we time.
@@ -279,6 +283,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     if is_lm:
         result["seq_len"] = seq_len
         result["tokens_per_sec"] = round(samples_per_s * seq_len, 1)
+    else:
+        result["image_hw"] = image_hw
     if warning:
         result["mfu_warning"] = warning
     if crosscheck_warning:

@@ -47,35 +47,52 @@ def _norm(name: str) -> str:
     return name[8:] if name.startswith("wrapped_") else name
 
 
-def load_trace(log_dir: str) -> Tuple[List[dict], Dict[int, str]]:
-    """(complete events, pid -> process name) from every trace.json.gz under
-    `log_dir` (one per host). Raises FileNotFoundError if no trace exists."""
+def load_trace(log_dir: str) -> Tuple[List[dict], Dict[int, str],
+                                      Dict[tuple, str]]:
+    """(complete events, pid -> process name, (pid, tid) -> thread name)
+    from every trace.json.gz under `log_dir` (one per host). Raises
+    FileNotFoundError if no trace exists."""
     paths = sorted(glob.glob(
         str(Path(log_dir) / "**" / "*.trace.json.gz"), recursive=True))
     if not paths:
         raise FileNotFoundError(f"no *.trace.json.gz under {log_dir}")
     events: List[dict] = []
     pids: Dict[int, str] = {}
+    tids: Dict[tuple, str] = {}
     for p in paths:
         data = json.loads(gzip.open(p).read())
         for e in data.get("traceEvents", []):
             if e.get("ph") == "M" and e.get("name") == "process_name":
                 pids[e.get("pid")] = e.get("args", {}).get("name", "")
+            elif e.get("ph") == "M" and e.get("name") == "thread_name":
+                tids[(e.get("pid"), e.get("tid"))] = (
+                    e.get("args", {}).get("name", ""))
             elif e.get("ph") == "X" and e.get("dur", 0) > 0:
                 events.append(e)
-    return events, pids
+    return events, pids, tids
 
 
-def xla_op_events(events: List[dict], pids: Dict[int, str]) -> List[dict]:
-    """The events that represent on-device XLA op execution.
+def xla_op_events(events: List[dict], pids: Dict[int, str],
+                  tids: Dict[tuple, str]) -> List[dict]:
+    """The events that represent on-device XLA op execution, counted ONCE.
 
-    TPU/GPU traces put ops on `/device:...` process lanes — use exactly
-    those. CPU traces (the test backend) run thunks on host threadpool
-    lanes, so fall back to name-based filtering of runtime bookkeeping.
+    TPU/GPU traces put ops on `/device:...` process lanes, but a device pid
+    carries several overlapping lanes ("XLA Modules" spans the same wall
+    time as the sum of its "XLA Ops") — summing all of them double-counts
+    busy time and halves the reported collective share, so restrict to the
+    per-op lanes when thread names identify them. CPU traces (the test
+    backend) run thunks on host threadpool lanes with no device pids; fall
+    back to name-based filtering of runtime bookkeeping.
     """
     device_pids = {pid for pid, name in pids.items() if "/device:" in name}
     if device_pids:
-        return [e for e in events if e.get("pid") in device_pids]
+        dev = [e for e in events if e.get("pid") in device_pids]
+        op_lanes = {key for key, name in tids.items()
+                    if key[0] in device_pids and "xla ops" in name.lower()}
+        if op_lanes:
+            return [e for e in dev
+                    if (e.get("pid"), e.get("tid")) in op_lanes]
+        return dev
     return [e for e in events
             if not _norm(e["name"]).startswith(_INFRA_PREFIXES)]
 
@@ -88,8 +105,8 @@ def collective_share(log_dir: str) -> dict:
     fraction of device busy time spent in communication — the number the
     reference's README placeholder wants (README.md:35).
     """
-    events, pids = load_trace(log_dir)
-    ops = xla_op_events(events, pids)
+    events, pids, tids = load_trace(log_dir)
+    ops = xla_op_events(events, pids, tids)
     coll_us = 0.0
     op_us = 0.0
     by_op: Dict[str, float] = {}

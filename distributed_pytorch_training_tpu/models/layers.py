@@ -9,7 +9,8 @@ TP design (megatron-style over the mesh's ``model`` axis, SURVEY.md §2c):
 * qkv projection kernels partitioned on the *output* (head) dim,
 * attention-out and MLP-down kernels partitioned on the *input* dim,
 so each device holds a head/neuron slice and XLA inserts exactly one
-all-reduce per residual join. The rules live in `tp_rules()`.
+all-reduce per residual join. The rules live in `tp_fsdp_rules()`
+(one table covers TP, FSDP, and their composition; trivial axes are inert).
 
 The attention inner product is pluggable (`attention_fn`) so the Pallas
 flash/ring kernels in `ops/` can replace the XLA einsum path per-config.
@@ -141,27 +142,6 @@ def causal_mask(seq_len: int) -> jnp.ndarray:
 def padding_mask(attention_mask: jnp.ndarray) -> jnp.ndarray:
     """(B, T) 1=real token -> (B, 1, 1, T) attend mask."""
     return attention_mask[:, None, None, :].astype(bool)
-
-
-def tp_rules() -> PartitionRules:
-    """Megatron-style tensor-parallel rules shared by every transformer here.
-
-    Matches the param paths produced by the modules above:
-    * `qkv/kernel` (d_model, 3, heads, head_dim): split heads -> axis 2
-    * `out/kernel` (heads, head_dim, d_model): split heads -> axis 0
-    * `mlp/fc1/kernel` (d_model, hidden): split hidden -> axis 1
-    * `mlp/fc2/kernel` (hidden, d_model): split hidden -> axis 0
-    * token embeddings (vocab, d_model): split vocab (megatron) -> axis 0
-    """
-    return PartitionRules([
-        (r"attn/qkv/kernel", P(None, None, MODEL, None)),
-        (r"attn/qkv/bias", P(None, MODEL, None)),
-        (r"attn/out/kernel", P(MODEL, None, None)),
-        (r"mlp/fc1/kernel", P(None, MODEL)),
-        (r"mlp/fc1/bias", P(MODEL)),
-        (r"mlp/fc2/kernel", P(MODEL, None)),
-        (r"(token_embedding|wte)/embedding", P(MODEL, None)),
-    ])
 
 
 def tp_fsdp_rules() -> PartitionRules:

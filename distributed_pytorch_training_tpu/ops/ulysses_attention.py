@@ -63,7 +63,7 @@ def ulysses_attention(
     n = mesh.shape[axis_name]
     if n == 1:
         return _local_attention(q, k, v, 0, causal, scale)
-    # Heads are head-sharded over `model` first (tp_rules) and then split
+    # Heads are head-sharded over `model` first (tp_fsdp_rules) and then split
     # again over `seq` by the all-to-all, so the constraint is on the product.
     model_n = mesh.shape.get(MODEL, 1)
     if q.shape[2] % (n * model_n):
@@ -72,13 +72,27 @@ def ulysses_attention(
             f"{axis_name!r} x 'model' axis sizes ({n} x {model_n}); use ring "
             "attention when heads are too few")
 
+    # After the all-to-all every device holds the FULL sequence for its head
+    # slice, so the local compute is exactly the single-device attention
+    # problem — use the blockwise Pallas kernel (O(S) memory, MXU-tiled)
+    # when the sequence divides its blocks; einsum otherwise (tiny S).
+    s_full = q.shape[1]
+    block = min(128, s_full)
+    use_flash = (s_full % block == 0)
+
     def body(q_loc, k_loc, v_loc):  # (B, S/n, H, D) local shards
         # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1)
         to_heads = functools.partial(
             lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
             tiled=True)
         qh, kh, vh = to_heads(q_loc), to_heads(k_loc), to_heads(v_loc)
-        out = _local_attention(qh, kh, vh, 0, causal, scale)  # (B, S, H/n, D)
+        if use_flash:
+            from .flash_attention import flash_attention
+
+            out = flash_attention(qh, kh, vh, causal, scale, block, block
+                                  ).astype(qh.dtype)  # (B, S, H/n, D)
+        else:
+            out = _local_attention(qh, kh, vh, 0, causal, scale)
         # head-sharded -> seq-sharded: split seq (axis 1), gather heads (axis 2)
         return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)

@@ -59,10 +59,24 @@ class Trainer:
         self.mesh = mesh
         self.config = config
         self.rules = rules
+        # optional MFU reference (set_mfu_reference): when present, the
+        # throughput print lines also report model-FLOPs utilization
+        self._flops_per_sample: Optional[float] = None
+        self._peak_flops_total: Optional[float] = None
 
         donate = (0,) if config.donate_state else ()
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=donate)
         self._eval_step = jax.jit(self._eval_step_impl)
+
+    def set_mfu_reference(self, flops_per_sample: float,
+                          peak_flops_total: float) -> None:
+        """Enable MFU in the step log: `flops_per_sample` is the analytic
+        train-step cost of ONE sample (experiments/flops.py),
+        `peak_flops_total` the summed peak FLOP/s of the mesh's devices.
+        The reference's meter stops at samples/s (train_ddp.py:224-243);
+        MFU is the same number made comparable across hardware."""
+        self._flops_per_sample = flops_per_sample
+        self._peak_flops_total = peak_flops_total
 
     # -- compiled bodies ---------------------------------------------------
 
@@ -143,11 +157,17 @@ class Trainer:
                 # Like the reference, the printed loss/acc are the epoch
                 # running averages (ref :230-231).
                 avg_loss, avg_acc = summarize(epoch_metrics)
+                rate = meter.rate()
+                mfu = ""
+                if self._flops_per_sample and self._peak_flops_total:
+                    mfu_pct = (100.0 * rate * self._flops_per_sample
+                               / self._peak_flops_total)
+                    mfu = f"  MFU: {mfu_pct:.1f}%"
                 log_main(
                     f"Epoch [{epoch + 1}] Step [{i + 1}/{steps_per_epoch}] "
                     f"Loss: {avg_loss:.4f}  "
                     f"Acc: {avg_acc:.2f}%  "
-                    f"Throughput: {meter.rate():.2f} samples/s (global)"
+                    f"Throughput: {rate:.2f} samples/s (global)" + mfu
                 )
                 meter.reset()
 

@@ -46,6 +46,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "gpt2_124m/gpt2_355m/gpt2_moe)")
     parser.add_argument("--dataset", default="cifar10", type=str,
                         help="dataset name (cifar10/imagenet)")
+    parser.add_argument("--download", action="store_true",
+                        help="fetch the dataset archive (checksum-verified) "
+                             "if absent; process 0 downloads, others wait at "
+                             "the barrier (ref :106-112 contract)")
     parser.add_argument("--synthetic", action="store_true",
                         help="force synthetic data (zero-egress environments)")
     parser.add_argument("--synthetic-size", default=None, type=int,

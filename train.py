@@ -94,6 +94,13 @@ def main(argv=None):
 
     compute_dtype = jnp.bfloat16 if args.amp else jnp.float32
     is_lm = args.model.startswith(("gpt2", "bert"))
+    if args.download and (is_lm or args.dataset.lower() != "cifar10"):
+        # never let a user believe they trained on fetched data when the
+        # flag was silently inapplicable
+        raise ValueError(
+            "--download supports --dataset cifar10 (the reference's "
+            "workload); LM/imagenet configs read preprocessed data from "
+            "--data-dir or use --synthetic")
 
     # Data (ref :332). Process 0 prepares first (it may extract an archive on
     # a shared filesystem); others wait at the barrier, then read — the exact
@@ -118,9 +125,12 @@ def main(argv=None):
             return train_ds, val_ds
     else:
         def _load_datasets():
+            # download only on process 0 (ref `download=(rank==0)`, :106);
+            # non-main processes reach here after the barrier, files on disk
             train_ds = get_dataset(args.dataset, args.data_dir, train=True,
                                    synthetic=args.synthetic,
-                                   synthetic_size=args.synthetic_size, seed=args.seed)
+                                   synthetic_size=args.synthetic_size, seed=args.seed,
+                                   download=args.download and ctx.is_main)
             val_ds = get_dataset(args.dataset, args.data_dir, train=False,
                                  synthetic=args.synthetic or train_ds.synthetic,
                                  synthetic_size=(args.synthetic_size or 0) // 5 or None,
@@ -242,6 +252,23 @@ def main(argv=None):
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
     log_main(f"Model {args.model}: {state.param_count():,} params")
+
+    # MFU in the step log (TPU only — needs a known chip peak): analytic
+    # matmul/conv FLOPs of one train step, traced once on a peeked batch.
+    from distributed_pytorch_training_tpu.experiments import flops as flops_mod
+
+    peak = flops_mod.chip_peak_tflops(dev0)
+    if peak:
+        try:
+            peek = next(iter(train_loader.epoch(0)))
+            fwd = flops_mod.jaxpr_matmul_flops(
+                lambda s, b: task.loss_and_metrics(
+                    s, s.params, b, jax.random.PRNGKey(0), train=True)[0],
+                state, peek)
+            trainer.set_mfu_reference(3.0 * fwd / global_batch,
+                                      peak * 1e12 * mesh.size)
+        except Exception as e:  # MFU is a log nicety, never a crash
+            log_main(f"NOTE: MFU logging disabled ({e})")
 
     # Checkpointing (extension; the reference has none — SURVEY.md §5).
     ckpt = None
