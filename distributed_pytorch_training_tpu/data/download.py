@@ -25,6 +25,7 @@ after retries, and `get_dataset` falls back to synthetic data loudly.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import shutil
 import tarfile
 import time
@@ -56,7 +57,8 @@ def sha256_file(path: Path, chunk: int = 1 << 20) -> str:
 
 
 def fetch(url: str, dest: str, sha256: Optional[str] = None, *,
-          retries: int = 3, timeout: float = 60.0) -> Path:
+          retries: int = 3, timeout: float = 60.0,
+          backoff: float = 2.0) -> Path:
     """Download `url` to `dest` (a file path), verified and atomic.
 
     Returns immediately (no network) when `dest` already exists and matches
@@ -78,37 +80,48 @@ def fetch(url: str, dest: str, sha256: Optional[str] = None, *,
             with urllib.request.urlopen(url, timeout=timeout) as r, \
                     open(part, "wb") as out:
                 shutil.copyfileobj(r, out)
-            break
-        except (urllib.error.URLError, OSError) as e:
+        # HTTPException covers IncompleteRead — a connection dropped
+        # mid-body — which is neither a URLError nor an OSError.
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
             last = e
             part.unlink(missing_ok=True)
             if attempt < retries:
-                time.sleep(min(2 ** attempt, 30))
-    else:
-        raise RuntimeError(
-            f"download failed after {retries} attempts: {url}: {last}")
-
-    if sha256 is not None:
-        got = sha256_file(part)
-        if got != sha256:
-            part.unlink()
-            raise ChecksumError(
-                f"{url}: SHA-256 mismatch: expected {sha256}, got {got}")
-    part.replace(dest_path)  # atomic: readers see absent or complete, never partial
-    return dest_path
+                time.sleep(min(backoff ** attempt, 30) if backoff else 0)
+            continue
+        # Verify INSIDE the retry loop: a dropped connection can also
+        # surface as a silently short body (no exception at all — observed
+        # with Content-Length mismatch), which only the digest catches.
+        # Transient truncation therefore retries; a persistently wrong file
+        # exhausts the attempts and raises ChecksumError.
+        got = sha256_file(part) if sha256 is not None else None
+        if sha256 is None or got == sha256:
+            # atomic: readers see absent or complete, never partial
+            part.replace(dest_path)
+            return dest_path
+        part.unlink()
+        last = ChecksumError(
+            f"{url}: SHA-256 mismatch: expected {sha256}, got {got}")
+        if attempt < retries:
+            time.sleep(min(backoff ** attempt, 30) if backoff else 0)
+    if isinstance(last, ChecksumError):
+        raise last
+    raise RuntimeError(
+        f"download failed after {retries} attempts: {url}: {last}")
 
 
 def fetch_and_extract(url: str, data_dir: str,
                       sha256: Optional[str] = None,
-                      filename: Optional[str] = None) -> Path:
+                      filename: Optional[str] = None,
+                      **fetch_kwargs) -> Path:
     """Fetch a .tar/.tar.gz archive into `data_dir` and extract it there.
 
     Returns the archive path. Extraction uses the stdlib 'data' filter
-    (no path traversal out of data_dir).
+    (no path traversal out of data_dir). Extra kwargs go to `fetch`.
     """
     data_dir_p = Path(data_dir)
     name = filename or url.rsplit("/", 1)[-1]
-    archive = fetch(url, str(data_dir_p / name), sha256)
+    archive = fetch(url, str(data_dir_p / name), sha256, **fetch_kwargs)
     with tarfile.open(archive) as tf:
         try:
             tf.extractall(data_dir_p, filter="data")

@@ -10,13 +10,18 @@ outline (/root/reference/README.md:27-35):
 * ``amp``      — bf16 vs fp32 step time (the "AMP vs FP32" comparison; on TPU
   bf16 replaces CUDA AMP, no GradScaler — SURVEY.md §2b).
 * ``gradsync`` — the gradient-synchronization share of step time (the
-  README's literal "~X%" placeholder, README.md:35). Two instruments:
+  README's literal "~X%" placeholder, README.md:35). Three instruments:
   (a) measured: per-device-constant-batch step time on 1 chip vs N chips —
       the extra time at N is the communication/sync overhead DDP hides in
       hooks and XLA hides in fused collectives;
   (b) static: a census of collective ops (all-reduce/all-gather/...) in the
       optimized HLO of the compiled step, with operand bytes — read from the
-      compiled executable the way the reference would read an nsys timeline.
+      compiled executable the way the reference would read an nsys timeline;
+  (c) trace-derived: a jax.profiler capture parsed by trace_analysis.py,
+      collective time summed against XLA-op busy time.
+* ``pipeline`` — GPipe bubble measurement: pipelined-GPT-2 throughput vs
+  microbatch count against the pure-DP layout of the same model
+  (bubble fraction (P-1)/(M+P-1); parallel/pipeline.py).
 
 Output: a markdown table on stdout + rows appended to a CSV so the scaling
 plots can be regenerated. Honest-measurement notes: on a single host the
@@ -240,11 +245,84 @@ def run_gradsync(args) -> List[dict]:
     return rows
 
 
+def run_pipeline(args) -> List[dict]:
+    """GPipe bubble measurement: pipelined GPT-2 throughput vs microbatch
+    count, against the pure-DP layout of the same model on the same devices.
+
+    The GPipe bubble fraction is (P-1)/(M+P-1) for P stages and M
+    microbatches — throughput should approach the DP baseline as M grows.
+    No analogue exists in the reference (DDP only); this quantifies the
+    cost/benefit of the `pipe` mesh axis (parallel/pipeline.py).
+    """
+    import numpy as _np
+
+    from ..models.gpt2_pipe import GPT2PipeLMHead
+    from ..parallel import MeshSpec, build_mesh, shard_batch
+    from ..training import TrainConfig, Trainer
+    from ..training.optim import adamw
+    from ..training.tasks import LanguageModelingTask
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return [{"config": "skipped", "samples_per_s": "needs >= 2 devices"}]
+
+    p_stages = 2
+    seq, vocab, hidden, depth, heads = 64, 256, 128, 4, 4
+    gb = (n // p_stages) * 8  # local batch 8 per shard: M in {1,2,4,8} divides
+    rng = _np.random.RandomState(0)
+    raw = {
+        "input_ids": rng.randint(0, vocab, (gb, seq)).astype(_np.int32),
+        "weight": _np.ones(gb, _np.float32),
+    }
+
+    def measure(mesh, model, rules):
+        trainer = Trainer(LanguageModelingTask(), mesh, TrainConfig(seed=0),
+                          rules=rules)
+        state = trainer.init_state(model, _np.zeros((1, seq), _np.int32),
+                                   adamw(1e-3), jax.random.PRNGKey(0))
+        batch = shard_batch(raw, mesh)
+        sps, samples = timed_steps(trainer._train_step, state, batch, gb,
+                                   args.steps, repeats=args.repeats,
+                                   min_window_s=args.min_window_s)
+        return samples
+
+    rows = []
+    # pure-DP baseline: same model as a plain scan over layers (pipe=1
+    # degenerates to sequential), all devices on the batch
+    mesh_dp = build_mesh(MeshSpec(data=n), devices=devices)
+    model_dp = GPT2PipeLMHead(mesh=mesh_dp, num_microbatches=1,
+                              vocab_size=vocab, hidden_dim=hidden,
+                              depth=depth, num_heads=heads, max_position=seq)
+    sps_dp = measure(mesh_dp, model_dp, GPT2PipeLMHead.partition_rules())
+    rows.append({"config": f"dp={n} (baseline)", "microbatches": "-",
+                 "samples_per_s": round(sps_dp, 1),
+                 "bubble_predicted_pct": 0.0, "vs_dp_pct": 100.0})
+
+    mesh_pp = build_mesh(MeshSpec(pipe=p_stages, data=n // p_stages),
+                         devices=devices)
+    for m in (1, 2, 4, 8):
+        model_pp = GPT2PipeLMHead(mesh=mesh_pp, num_microbatches=m,
+                                  vocab_size=vocab, hidden_dim=hidden,
+                                  depth=depth, num_heads=heads,
+                                  max_position=seq)
+        sps = measure(mesh_pp, model_pp, GPT2PipeLMHead.partition_rules())
+        bubble = (p_stages - 1) / (m + p_stages - 1)
+        rows.append({
+            "config": f"pipe={p_stages},data={n // p_stages}",
+            "microbatches": m,
+            "samples_per_s": round(sps, 1),
+            "bubble_predicted_pct": round(100.0 * bubble, 1),
+            "vs_dp_pct": round(100.0 * sps / sps_dp, 1),
+        })
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
-                   choices=["scaling", "batch", "amp", "gradsync"])
+                   choices=["scaling", "batch", "amp", "gradsync", "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
@@ -262,7 +340,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
-          "gradsync": run_gradsync}[args.experiment]
+          "gradsync": run_gradsync, "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "
           f"{len(jax.devices())} device(s) [{jax.default_backend()}]\n")

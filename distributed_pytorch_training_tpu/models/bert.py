@@ -71,6 +71,7 @@ class BertForMaskedLM(nn.Module):
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-12
     attention_fn: Callable = dot_product_attention
+    remat: bool = False  # jax.checkpoint each block: HBM for recompute FLOPs
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -93,8 +94,9 @@ class BertForMaskedLM(nn.Module):
                          param_dtype=self.param_dtype, name="embed_ln")(x)
 
         mask = padding_mask(attention_mask) if attention_mask is not None else None
+        block_cls = nn.remat(BertBlock) if self.remat else BertBlock
         for i in range(self.depth):
-            x = BertBlock(num_heads=self.num_heads,
+            x = block_cls(num_heads=self.num_heads,
                           head_dim=self.hidden_dim // self.num_heads,
                           mlp_dim=self.mlp_dim, dtype=self.dtype,
                           param_dtype=self.param_dtype,

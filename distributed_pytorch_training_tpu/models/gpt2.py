@@ -39,6 +39,7 @@ class GPT2LMHead(nn.Module):
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-5
     attention_fn: Callable = dot_product_attention
+    remat: bool = False  # jax.checkpoint each block: HBM for recompute FLOPs
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = False):
@@ -67,8 +68,9 @@ class GPT2LMHead(nn.Module):
             if attention_mask is not None:
                 mask = mask & attention_mask[:, None, None, :].astype(bool)
 
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 head_dim=self.hidden_dim // self.num_heads,
                 mlp_dim=4 * self.hidden_dim, dtype=self.dtype,

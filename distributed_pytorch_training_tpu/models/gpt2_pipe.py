@@ -58,6 +58,7 @@ class GPT2PipeLMHead:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-5
+    remat: bool = False  # jax.checkpoint each stage layer (HBM for FLOPs)
 
     def _block(self) -> TransformerBlock:
         return TransformerBlock(
@@ -125,6 +126,8 @@ class GPT2PipeLMHead:
             return block.apply({"params": layer_params}, h, mask=mask,
                                deterministic=True)
 
+        if self.remat:
+            apply_layer = jax.checkpoint(apply_layer)
         x = pipeline_apply(apply_layer, params["blocks"], x, self.mesh,
                            self.num_microbatches)
 

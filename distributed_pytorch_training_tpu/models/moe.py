@@ -174,6 +174,10 @@ class GPT2MoELMHead(nn.Module):
     layernorm_epsilon: float = 1e-5
     attention_fn: Optional[Callable] = None
     router_noise: float = 0.0
+    # jax.checkpoint the DENSE blocks only: MoE blocks sow the router
+    # aux-loss into the "losses" collection, which remat would complicate;
+    # half the layers is still half the activation memory.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = False):
@@ -208,7 +212,9 @@ class GPT2MoELMHead(nn.Module):
                     router_noise=self.router_noise,
                     name=f"block{i}")(x, mask=mask, deterministic=not train)
             else:
-                x = TransformerBlock(
+                dense_cls = (nn.remat(TransformerBlock) if self.remat
+                             else TransformerBlock)
+                x = dense_cls(
                     num_heads=self.num_heads, head_dim=head_dim,
                     mlp_dim=4 * self.hidden_dim, dtype=self.dtype,
                     param_dtype=self.param_dtype,

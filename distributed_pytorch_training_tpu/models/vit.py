@@ -29,6 +29,7 @@ class ViT(nn.Module):
     param_dtype: Any = jnp.float32
     layernorm_epsilon: float = 1e-6
     attention_fn: Callable = dot_product_attention
+    remat: bool = False  # jax.checkpoint each block: HBM for recompute FLOPs
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -49,8 +50,9 @@ class ViT(nn.Module):
                          (1, x.shape[1], self.hidden_dim), self.param_dtype)
         x = x + pos.astype(self.dtype)
 
+        block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 head_dim=self.hidden_dim // self.num_heads,
                 mlp_dim=self.mlp_dim, dtype=self.dtype,

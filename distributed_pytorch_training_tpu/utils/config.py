@@ -71,6 +71,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "xla einsum, Pallas flash kernel, ring (KV "
                              "rotation over the mesh seq axis), or ulysses "
                              "(all-to-all head sharding over seq)")
+    parser.add_argument("--remat", action="store_true",
+                        help="gradient checkpointing: recompute each "
+                             "transformer block in the backward pass "
+                             "(jax.checkpoint) — trades FLOPs for HBM, "
+                             "enabling longer sequences / bigger batches")
     parser.add_argument("--schedule", default="constant", type=str,
                         help="lr schedule: constant | cosine | linear_warmup")
     parser.add_argument("--warmup-steps", default=0, type=int)

@@ -90,7 +90,8 @@ def test_fetch_rejects_bad_checksum(tmp_path, payload):
     srv = _Server({"/blob.bin": data})
     try:
         with pytest.raises(ChecksumError, match="SHA-256 mismatch"):
-            fetch(srv.url("/blob.bin"), str(tmp_path / "x"), "0" * 64)
+            fetch(srv.url("/blob.bin"), str(tmp_path / "x"), "0" * 64,
+                  backoff=0)
         # a rejected download leaves NOTHING behind a loader could read
         assert list(tmp_path.iterdir()) == []
     finally:
@@ -102,7 +103,7 @@ def test_fetch_retries_transient_errors(tmp_path, payload):
     srv = _Server({"/blob.bin": data}, fail_first=2)
     try:
         dest = fetch(srv.url("/blob.bin"), str(tmp_path / "b"), digest,
-                     retries=3)
+                     retries=3, backoff=0)
         assert sha256_file(dest) == digest
     finally:
         srv.close()
@@ -194,7 +195,44 @@ def test_fetch_and_extract_rejects_bad_archive_checksum(tmp_path):
     srv = _Server({"/a.tar.gz": raw})
     try:
         with pytest.raises(ChecksumError):
-            fetch_and_extract(srv.url("/a.tar.gz"), str(tmp_path), "f" * 64)
+            fetch_and_extract(srv.url("/a.tar.gz"), str(tmp_path), "f" * 64,
+                              backoff=0)
         assert not (tmp_path / "cifar-10-batches-py").exists()
     finally:
         srv.close()
+
+
+def test_fetch_retries_truncated_body(tmp_path, payload):
+    """A connection dropped mid-body raises http.client.IncompleteRead (an
+    HTTPException, not an OSError) — it must be retried like any other
+    transient network failure."""
+    import http.server
+
+    data, digest = payload
+    state = {"truncate": 1}
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            if state["truncate"] > 0:
+                state["truncate"] -= 1
+                self.wfile.write(data[: len(data) // 2])  # truncated body
+                self.wfile.flush()
+                self.connection.close()
+            else:
+                self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/b"
+        dest = fetch(url, str(tmp_path / "b"), digest, retries=3, backoff=0)
+        assert sha256_file(dest) == digest
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

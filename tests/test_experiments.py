@@ -99,3 +99,11 @@ def test_experiment_gradsync_smoke(capsys, tmp_path):
     assert "grad_sync_share_trace_pct" in out
     assert "all-reduce" in out  # census + trace breakdown both present
     assert (tmp_path / "gs.csv").exists()
+
+
+def test_experiment_pipeline_smoke(capsys):
+    _run_experiment(["pipeline"] + _SMOKE)
+    out = capsys.readouterr().out
+    assert "bubble_predicted_pct" in out
+    assert "dp=8 (baseline)" in out
+    assert "pipe=2,data=4" in out

@@ -148,3 +148,34 @@ def test_moe_trains_expert_parallel(devices):
     assert np.isfinite(float(metrics["loss_sum"]))
     wi_after = np.asarray(jax.device_get(state2.params["block1"]["moe"]["wi"]))
     assert np.abs(wi_after - wi_before).sum() > 0  # experts actually updated
+
+
+def test_moe_remat_trains(devices):
+    """gpt2_moe with --remat: dense blocks checkpointed, MoE blocks (which
+    sow the router aux loss) left plain — the step must still run and sow."""
+    import numpy as np
+
+    from distributed_pytorch_training_tpu.models import get_model
+    from distributed_pytorch_training_tpu.parallel import (
+        MeshSpec, build_mesh, shard_batch,
+    )
+    from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
+    from distributed_pytorch_training_tpu.training.optim import adamw
+    from distributed_pytorch_training_tpu.training.tasks import (
+        MoeLanguageModelingTask,
+    )
+
+    mesh = build_mesh(MeshSpec(data=4, expert=2), devices=devices)
+    model = get_model("gpt2_moe", vocab_size=64, hidden_dim=16, depth=2,
+                      num_heads=2, num_experts=2, max_position=16, remat=True)
+    tr = Trainer(MoeLanguageModelingTask(), mesh, TrainConfig(seed=0),
+                 rules=type(model).partition_rules())
+    st = tr.init_state(model, np.zeros((1, 16), np.int32), adamw(1e-3),
+                       jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = shard_batch({
+        "input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32),
+        "weight": np.ones(8, np.float32),
+    }, mesh)
+    st, m = tr._train_step(st, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(m["loss_sum"]))

@@ -2,6 +2,8 @@
 ``pipe`` mesh axis must match sequential layer application — forward AND
 backward (autodiff through scan+ppermute is the reverse schedule)."""
 
+import dataclasses
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -162,3 +164,15 @@ def test_pipelined_training_step_decreases_loss(devices):
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
     assert int(state.step) == 8
+
+
+def test_pipelined_remat_matches_plain(devices):
+    """jax.checkpoint inside pipeline stages changes memory, not math."""
+    mesh = build_mesh(MeshSpec(pipe=2, data=4), devices=devices)
+    plain = _pipe_gpt2(mesh)
+    variables = plain.init(jax.random.PRNGKey(0), np.zeros((1, 16), np.int32))
+    remat = dataclasses.replace(plain, remat=True)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 16)))
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(variables, ids)),
+        np.asarray(remat.apply(variables, ids)), rtol=1e-5, atol=1e-5)

@@ -157,7 +157,7 @@ def main(argv=None):
                                    seed=args.seed, drop_last=args.drop_last)
         val_loader = TokenLoader(val_ds, mesh, args.batch_size, shuffle=False,
                                  seed=args.seed)
-        lm_kwargs = dict(dtype=compute_dtype)
+        lm_kwargs = dict(dtype=compute_dtype, remat=args.remat)
         if args.attention != "xla":
             if family == "bert":
                 raise ValueError("--attention flash/ring is causal-only; "
@@ -198,7 +198,7 @@ def main(argv=None):
                 vocab_size=cfg.vocab_size, hidden_dim=cfg.hidden_dim,
                 depth=cfg.depth, num_heads=cfg.num_heads,
                 max_position=max(cfg.max_position, seq_len),
-                dtype=compute_dtype)
+                dtype=compute_dtype, remat=args.remat)
         else:
             model = get_model(args.model, **lm_kwargs)
         if family == "bert":
@@ -220,6 +220,11 @@ def main(argv=None):
         model_kwargs = dict(num_classes=train_ds.num_classes, dtype=compute_dtype)
         if args.model.startswith("resnet"):
             model_kwargs["cifar_stem"] = args.cifar_stem
+            if args.remat:
+                raise ValueError("--remat applies to transformer models "
+                                 "(vit/bert/gpt2); ResNets are activation-light")
+        elif args.remat:
+            model_kwargs["remat"] = True
         model = get_model(args.model, **model_kwargs)
         task = ImageClassificationTask(mean=mean, std=std,
                                        augment=not args.no_augment,
