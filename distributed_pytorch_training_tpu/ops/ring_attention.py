@@ -16,6 +16,13 @@ flow stays uniform across devices, as XLA requires.
 Implemented with ``lax.scan`` (reverse-differentiable; ``ppermute`` has a
 transpose rule, so gradients also ride the ring — no custom VJP needed) and
 wrapped in ``shard_map`` so it composes inside a jitted train step.
+
+Memory note: each ring step materializes the local (S/n, S/n) score block in
+fp32 (XLA einsum). The cross-DEVICE memory is the O(S/n) ring win; per-step
+locality is bounded by the shard length. When a single shard's scores exceed
+VMEM-friendly sizes, prefer `ops.ulysses_attention` (which runs the
+blockwise Pallas kernel on full sequences after its all-to-all) or grow the
+`seq` axis. A fused ring+Pallas inner block is a further optimization.
 """
 
 from __future__ import annotations
@@ -34,39 +41,86 @@ from ..parallel.mesh import BATCH_AXES, MODEL, SEQ
 NEG_INF = float(np.finfo(np.float32).min)
 
 
-def _ring_body(q, k, v, axis_name: str, causal: bool, sm_scale: float):
-    """Per-device body (inside shard_map). q/k/v: (B, S_loc, H, D) local."""
+def _ring_body(q, k, v, axis_name: str, causal: bool, sm_scale: float,
+               q_chunk: int = 512):
+    """Per-device body (inside shard_map). q/k/v: (B, S_loc, H, D) local.
+
+    Within each ring step the local score block is computed in Q row chunks
+    of `q_chunk` under ``jax.checkpoint``, so live memory per step is
+    O(q_chunk * S_loc) instead of O(S_loc^2) — the blockwise-attention trick
+    applied along the ring (shards with S_loc <= q_chunk take the single
+    straight-through block, identical to the unchunked formulation).
+    """
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
 
     qf = q.astype(jnp.float32) * sm_scale
 
-    def step(carry, t):
-        k_cur, v_cur, m, l, acc = carry
-        j = (my_idx - t) % n  # which global shard this K/V block is
-        s = jnp.einsum("bshd,bthd->bhst", qf, k_cur.astype(jnp.float32))
+    # largest divisor of s_loc that is <= q_chunk, so the memory bound holds
+    # for every shard length (not only powers of two)
+    c = min(q_chunk, s_loc)
+    while s_loc % c:
+        c -= 1
+    nc = s_loc // c
+
+    def block_update(q_blk, k_cur, v_cur, m, l, acc, row0, j):
+        """Online-softmax update of one (c, S_loc) score block.
+        q_blk: (B, c, H, D); m/l: (B, H, c); acc: (B, H, c, D)."""
+        s = jnp.einsum("bshd,bthd->bhst", q_blk, k_cur.astype(jnp.float32))
         if causal:
-            rows = my_idx * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 0)
+            rows = my_idx * s_loc + row0 + lax.broadcasted_iota(
+                jnp.int32, (c, s_loc), 0)
             cols = j * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1)
+                jnp.int32, (c, s_loc), 1)
             valid = (rows >= cols)[None, None]
-        else:
-            valid = jnp.ones((1, 1, s_loc, s_loc), bool)
-        s = jnp.where(valid, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))  # (B, H, S)
-        p = jnp.where(valid, jnp.exp(s - m_new[..., None]), 0.0)
+            s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))  # (B, H, c)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
         acc_new = (acc * alpha[..., None]
                    + jnp.einsum("bhst,bthd->bhsd", p,
                                 v_cur.astype(jnp.float32)))
+        return m_new, l_new, acc_new
+
+    if nc > 1:
+        # recompute each block in the backward instead of storing its p
+        block_update = jax.checkpoint(block_update)
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        j = (my_idx - t) % n  # which global shard this K/V block is
+        if nc == 1:
+            m, l, acc = block_update(qf, k_cur, v_cur, m, l, acc, 0, j)
+        else:
+            # chunks are independent rows: map over them, threading only
+            # that chunk's (m, l, acc) slice
+            q_c = qf.reshape(b, nc, c, h, d).transpose(1, 0, 2, 3, 4)
+            m_c = m.reshape(b, h, nc, c).transpose(2, 0, 1, 3)
+            l_c = l.reshape(b, h, nc, c).transpose(2, 0, 1, 3)
+            acc_c = acc.reshape(b, h, nc, c, d).transpose(2, 0, 1, 3, 4)
+
+            def one_chunk(i, args):
+                qb, mb, lb, ab = args
+                return block_update(qb, k_cur, v_cur, mb, lb, ab, i * c, j)
+
+            def scan_fn(_, xs):
+                i, args = xs
+                return None, one_chunk(i, args)
+
+            _, (m_c, l_c, acc_c) = lax.scan(
+                scan_fn, None, (jnp.arange(nc), (q_c, m_c, l_c, acc_c)))
+            m = m_c.transpose(1, 2, 0, 3).reshape(b, h, s_loc)
+            l = l_c.transpose(1, 2, 0, 3).reshape(b, h, s_loc)
+            acc = acc_c.transpose(1, 2, 0, 3, 4).reshape(b, h, s_loc, d)
         # rotate K/V to the next device on the ring (one ICI hop)
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+        return (k_nxt, v_nxt, m, l, acc), None
 
     m0 = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
@@ -85,26 +139,30 @@ def ring_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     axis_name: str = SEQ,
+    q_chunk: int = 512,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over the mesh `seq` axis.
 
     Composes inside jit: shard_map forces the (B, S, H, D) operands onto
     (batch-axes, seq, model, -) layout; XLA reshards neighbors as needed.
     With seq axis size 1 this degrades to ordinary attention semantics.
+    `q_chunk` bounds per-ring-step score memory (see `_ring_body`).
     """
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
     spec = P(BATCH_AXES, axis_name, MODEL, None)
     body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                             sm_scale=scale)
+                             sm_scale=scale, q_chunk=q_chunk)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
-def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ):
+def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ,
+                           q_chunk: int = 512):
     """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`.
 
     As with the flash path, explicit masks are unsupported — causal structure
-    is positional, computed from global offsets on each shard.
+    is positional, computed from global offsets on each shard. `q_chunk`
+    bounds per-ring-step score memory (forwarded to `ring_attention`).
     """
 
     def attention_fn(q, k, v, mask=None, dtype=jnp.float32):
@@ -113,6 +171,7 @@ def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ):
                 "ring attention handles causal masking internally; explicit "
                 "masks require the XLA attention path")
         return ring_attention(q, k, v, mesh, causal=causal,
-                              axis_name=axis_name).astype(dtype)
+                              axis_name=axis_name,
+                              q_chunk=q_chunk).astype(dtype)
 
     return attention_fn
