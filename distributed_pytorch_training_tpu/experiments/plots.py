@@ -1,0 +1,198 @@
+"""Plot generation for the experiment CSVs — the "plots" half of the
+reference README's promised "Tables + plots" (/root/reference/README.md:27-31,
+an empty outline there; the tables come from experiments/scaling.py --csv).
+
+Usage:
+    python -m distributed_pytorch_training_tpu.experiments.plots \
+        results.csv --out scaling.png [--kind scaling] [--dark]
+
+The kind is auto-detected from the CSV columns when not given. One figure per
+CSV: scaling (throughput + efficiency vs chips), batch (throughput vs
+per-device batch), amp (fp32 vs bf16 bars), gradsync (share bars), pipeline
+(throughput vs microbatches with the predicted-bubble ceiling).
+
+Style notes: single measure -> single hue (no legend needed — the title names
+the series); values are direct-labeled selectively (ends/extremes); grids and
+axes stay recessive so the data ink dominates. The hues are the validated
+defaults from the dataviz reference palette (light surface).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+# Validated default palette (light mode): slot-1 blue for the primary series,
+# slot-2 orange only when a genuinely second series exists.
+BLUE = "#2a78d6"
+ORANGE = "#eb6834"
+INK = "#1f2430"
+MUTED = "#6b7280"
+GRID = "#e5e7eb"
+
+
+def _read(csv_path: str) -> List[Dict[str, str]]:
+    with open(csv_path, newline="") as f:
+        return list(csv.DictReader(f))
+
+
+def detect_kind(rows: List[Dict[str, str]]) -> str:
+    cols = set(rows[0].keys())
+    if "scaling_efficiency_pct" in cols:
+        return "scaling"
+    if "bubble_predicted_pct" in cols:
+        return "pipeline"
+    if "precision" in cols:
+        return "amp"
+    if "per_device_batch" in cols:
+        return "batch"
+    if "measurement" in cols:
+        return "gradsync"
+    raise ValueError(f"cannot detect experiment kind from columns {cols}")
+
+
+def _style(ax):
+    ax.spines["top"].set_visible(False)
+    ax.spines["right"].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(GRID)
+    ax.tick_params(colors=MUTED, labelsize=9)
+    ax.grid(True, axis="y", color=GRID, linewidth=0.8)
+    ax.set_axisbelow(True)
+
+
+def _fig(title: str, ylabel: str, xlabel: str):
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(6.4, 4.0), dpi=144)
+    ax.set_title(title, color=INK, fontsize=11, loc="left", pad=12)
+    ax.set_ylabel(ylabel, color=MUTED, fontsize=9)
+    ax.set_xlabel(xlabel, color=MUTED, fontsize=9)
+    _style(ax)
+    return fig, ax
+
+
+def plot_scaling(rows, out: str) -> None:
+    xs = [int(r["chips"]) for r in rows]
+    ys = [float(r["global_samples_per_s"]) for r in rows]
+    eff = [float(r["scaling_efficiency_pct"]) for r in rows]
+    fig, ax = _fig("Data-parallel scaling — global throughput",
+                   "samples / s", "chips")
+    ideal = [ys[0] * x / xs[0] for x in xs]
+    ax.plot(xs, ideal, color=GRID, linewidth=2, linestyle="--", zorder=1)
+    ax.annotate("ideal linear", (xs[-1], ideal[-1]), color=MUTED, fontsize=8,
+                ha="right", va="bottom")
+    ax.plot(xs, ys, color=BLUE, linewidth=2, marker="o", markersize=5,
+            zorder=3)
+    ax.annotate(f"{eff[-1]:.0f}% efficiency", (xs[-1], ys[-1]), color=INK,
+                fontsize=9, ha="right", va="top", xytext=(0, -10),
+                textcoords="offset points")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(xs, [str(x) for x in xs])
+    fig.savefig(out, bbox_inches="tight")
+
+
+def plot_batch(rows, out: str) -> None:
+    xs = [int(r["per_device_batch"]) for r in rows]
+    ys = [float(r["global_samples_per_s"]) for r in rows]
+    fig, ax = _fig("Throughput vs per-device batch size", "samples / s",
+                   "per-device batch")
+    ax.plot(xs, ys, color=BLUE, linewidth=2, marker="o", markersize=5)
+    ax.annotate(f"{ys[-1]:,.0f}", (xs[-1], ys[-1]), color=INK, fontsize=9,
+                ha="left", va="center", xytext=(6, 0),
+                textcoords="offset points")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(xs, [str(x) for x in xs])
+    fig.savefig(out, bbox_inches="tight")
+
+
+def plot_amp(rows, out: str) -> None:
+    pairs = [(r["precision"], float(r["global_samples_per_s"]))
+             for r in rows if r["precision"] in ("fp32", "bf16")]
+    speed = [float(r["global_samples_per_s"]) for r in rows
+             if r["precision"] == "bf16_speedup"]
+    fig, ax = _fig("Mixed precision — bf16 vs true fp32 throughput",
+                   "samples / s", "")
+    names = [p[0] for p in pairs]
+    vals = [p[1] for p in pairs]
+    bars = ax.bar(names, vals, color=BLUE, width=0.55, zorder=3)
+    for b, v in zip(bars, vals):
+        ax.annotate(f"{v:,.0f}", (b.get_x() + b.get_width() / 2, v),
+                    ha="center", va="bottom", color=INK, fontsize=9,
+                    xytext=(0, 3), textcoords="offset points")
+    if speed:
+        ax.set_title(f"Mixed precision — bf16 is {speed[0]:.2f}x fp32 "
+                     "(HIGHEST-precision matmuls)", color=INK, fontsize=11,
+                     loc="left", pad=12)
+    fig.savefig(out, bbox_inches="tight")
+
+
+def plot_gradsync(rows, out: str) -> None:
+    vals = {r["measurement"]: float(r["value"]) for r in rows}
+    keys = [k for k in ("grad_sync_share_1vsN_pct",
+                        "grad_sync_share_trace_pct") if k in vals]
+    labels = {"grad_sync_share_1vsN_pct": "1-vs-N step time",
+              "grad_sync_share_trace_pct": "profiler trace"}
+    fig, ax = _fig("Gradient-sync share of step time — two instruments",
+                   "% of step time", "")
+    names = [labels[k] for k in keys]
+    ys = [vals[k] for k in keys]
+    bars = ax.bar(names, ys, color=BLUE, width=0.5, zorder=3)
+    for b, v in zip(bars, ys):
+        ax.annotate(f"{v:.1f}%", (b.get_x() + b.get_width() / 2, v),
+                    ha="center", va="bottom", color=INK, fontsize=9,
+                    xytext=(0, 3), textcoords="offset points")
+    fig.savefig(out, bbox_inches="tight")
+
+
+def plot_pipeline(rows, out: str) -> None:
+    base = [r for r in rows if r["microbatches"] == "-"]
+    pipe = [r for r in rows if r["microbatches"] != "-"]
+    xs = [int(r["microbatches"]) for r in pipe]
+    ys = [float(r["samples_per_s"]) for r in pipe]
+    fig, ax = _fig("GPipe throughput vs microbatches", "samples / s",
+                   "microbatches (bubble = (P-1)/(M+P-1))")
+    if base:
+        b = float(base[0]["samples_per_s"])
+        ax.axhline(b, color=GRID, linewidth=2, linestyle="--", zorder=1)
+        ax.annotate("pure-DP baseline", (xs[-1], b), color=MUTED, fontsize=8,
+                    ha="right", va="bottom")
+    ax.plot(xs, ys, color=BLUE, linewidth=2, marker="o", markersize=5,
+            zorder=3)
+    for x, y, r in zip(xs, ys, pipe):
+        ax.annotate(f"{float(r['bubble_predicted_pct']):.0f}% bubble",
+                    (x, y), color=MUTED, fontsize=8, ha="center", va="top",
+                    xytext=(0, -8), textcoords="offset points")
+    ax.set_xticks(xs, [str(x) for x in xs])
+    fig.savefig(out, bbox_inches="tight")
+
+
+PLOTTERS = {"scaling": plot_scaling, "batch": plot_batch, "amp": plot_amp,
+            "gradsync": plot_gradsync, "pipeline": plot_pipeline}
+
+
+def main(argv=None):
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless: bench hosts have no display
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("csv", help="CSV from experiments/scaling.py --csv")
+    p.add_argument("--kind", choices=sorted(PLOTTERS), default=None)
+    p.add_argument("--out", default=None, help="output PNG path")
+    args = p.parse_args(argv)
+
+    rows = _read(args.csv)
+    if not rows:
+        raise SystemExit(f"{args.csv}: empty CSV")
+    kind = args.kind or detect_kind(rows)
+    out = args.out or str(Path(args.csv).with_suffix(f".{kind}.png"))
+    PLOTTERS[kind](rows, out)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -17,12 +17,13 @@ Implemented with ``lax.scan`` (reverse-differentiable; ``ppermute`` has a
 transpose rule, so gradients also ride the ring — no custom VJP needed) and
 wrapped in ``shard_map`` so it composes inside a jitted train step.
 
-Memory note: each ring step materializes the local (S/n, S/n) score block in
-fp32 (XLA einsum). The cross-DEVICE memory is the O(S/n) ring win; per-step
-locality is bounded by the shard length. When a single shard's scores exceed
-VMEM-friendly sizes, prefer `ops.ulysses_attention` (which runs the
-blockwise Pallas kernel on full sequences after its all-to-all) or grow the
-`seq` axis. A fused ring+Pallas inner block is a further optimization.
+Memory note: the cross-DEVICE memory is the O(S/n) ring win; within a ring
+step the local score block is computed in Q row chunks under
+``jax.checkpoint`` (``q_chunk``, default 512), bounding live memory to
+O(q_chunk x S/n) in forward and backward instead of the full (S/n, S/n)
+block. A fused ring+Pallas inner block is a further optimization;
+`ops.ulysses_attention` offers the alternative all-to-all layout that runs
+the Pallas kernel on full sequences.
 """
 
 from __future__ import annotations
@@ -57,11 +58,17 @@ def _ring_body(q, k, v, axis_name: str, causal: bool, sm_scale: float,
 
     qf = q.astype(jnp.float32) * sm_scale
 
-    # largest divisor of s_loc that is <= q_chunk, so the memory bound holds
-    # for every shard length (not only powers of two)
+    # Largest divisor of s_loc in [q_chunk/2, q_chunk]; shard lengths are
+    # normally 128-multiples so this finds q_chunk itself. Pathological
+    # lengths (e.g. primes) get NO near-size divisor — falling through to
+    # tiny chunks would serialize the MXU (c=1 means s_loc scan steps of
+    # rank-1 matmuls), so those take the single straight-through block
+    # instead: correctness and throughput over the memory bound.
     c = min(q_chunk, s_loc)
-    while s_loc % c:
+    while s_loc % c and c > q_chunk // 2:
         c -= 1
+    if s_loc % c:
+        c = s_loc
     nc = s_loc // c
 
     def block_update(q_blk, k_cur, v_cur, m, l, acc, row0, j):

@@ -107,3 +107,51 @@ def test_experiment_pipeline_smoke(capsys):
     assert "bubble_predicted_pct" in out
     assert "dp=8 (baseline)" in out
     assert "pipe=2,data=4" in out
+
+
+def test_plot_generation_all_kinds(tmp_path):
+    """plots.py renders a PNG for every experiment CSV shape (the README's
+    'Tables + plots' promise — plots regenerate from the CSVs)."""
+    import csv as csv_mod
+
+    from distributed_pytorch_training_tpu.experiments import plots
+
+    fixtures = {
+        "scaling": [
+            {"chips": 1, "global_samples_per_s": 100.0,
+             "per_chip_samples_per_s": 100.0, "scaling_efficiency_pct": 100.0},
+            {"chips": 8, "global_samples_per_s": 730.0,
+             "per_chip_samples_per_s": 91.2, "scaling_efficiency_pct": 91.2},
+        ],
+        "batch": [
+            {"per_device_batch": 32, "global_samples_per_s": 50.0},
+            {"per_device_batch": 256, "global_samples_per_s": 300.0},
+        ],
+        "amp": [
+            {"precision": "fp32", "global_samples_per_s": 100.0},
+            {"precision": "bf16", "global_samples_per_s": 420.0},
+            {"precision": "bf16_speedup", "global_samples_per_s": 4.2},
+        ],
+        "gradsync": [
+            {"measurement": "step_time_1chip_ms", "value": 10.0},
+            {"measurement": "grad_sync_share_1vsN_pct", "value": 12.0},
+            {"measurement": "grad_sync_share_trace_pct", "value": 10.5},
+        ],
+        "pipeline": [
+            {"config": "dp=8 (baseline)", "microbatches": "-",
+             "samples_per_s": 100.0, "bubble_predicted_pct": 0.0,
+             "vs_dp_pct": 100.0},
+            {"config": "pipe=2,data=4", "microbatches": 4,
+             "samples_per_s": 80.0, "bubble_predicted_pct": 20.0,
+             "vs_dp_pct": 80.0},
+        ],
+    }
+    for kind, rows in fixtures.items():
+        path = tmp_path / f"{kind}.csv"
+        with open(path, "w", newline="") as f:
+            w = csv_mod.DictWriter(f, fieldnames=list(rows[0]))
+            w.writeheader()
+            w.writerows(rows)
+        out = tmp_path / f"{kind}.png"
+        plots.main([str(path), "--out", str(out)])  # kind auto-detected
+        assert out.exists() and out.stat().st_size > 5000, kind
