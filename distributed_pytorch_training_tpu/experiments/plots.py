@@ -64,6 +64,27 @@ def _style(ax):
     ax.set_axisbelow(True)
 
 
+def _latest(rows: List[Dict[str, str]], kind: str) -> List[Dict[str, str]]:
+    """Keep the LAST row per x-key: the documented workflow APPENDS rows
+    across runs (scaling.py's --csv opens in append mode), so a re-run CSV
+    holds several sweeps — plots reflect the most recent one, in its order,
+    instead of zigzagging across all of them."""
+    keys = {
+        "scaling": lambda r: r["chips"],
+        "batch": lambda r: r["per_device_batch"],
+        "amp": lambda r: r["precision"],
+        "gradsync": lambda r: r["measurement"],
+        "pipeline": lambda r: (r["config"], r["microbatches"]),
+    }[kind]
+    latest: Dict = {}
+    for r in rows:  # dict preserves first-seen order; overwrite keeps order
+        k = keys(r)
+        if k in latest:
+            del latest[k]  # re-append so the NEW run's ordering wins
+        latest[k] = r
+    return list(latest.values())
+
+
 def _fig(title: str, ylabel: str, xlabel: str):
     import matplotlib.pyplot as plt
 
@@ -175,7 +196,12 @@ PLOTTERS = {"scaling": plot_scaling, "batch": plot_batch, "amp": plot_amp,
 
 
 def main(argv=None):
-    import matplotlib
+    try:
+        import matplotlib
+    except ImportError as e:  # an optional extra, not a core dependency
+        raise SystemExit(
+            "plots need matplotlib: pip install "
+            "'distributed-pytorch-training-tpu[plots]'") from e
 
     matplotlib.use("Agg")  # headless: bench hosts have no display
 
@@ -190,7 +216,7 @@ def main(argv=None):
         raise SystemExit(f"{args.csv}: empty CSV")
     kind = args.kind or detect_kind(rows)
     out = args.out or str(Path(args.csv).with_suffix(f".{kind}.png"))
-    PLOTTERS[kind](rows, out)
+    PLOTTERS[kind](_latest(rows, kind), out)
     print(f"wrote {out}")
 
 

@@ -155,3 +155,28 @@ def test_plot_generation_all_kinds(tmp_path):
         out = tmp_path / f"{kind}.png"
         plots.main([str(path), "--out", str(out)])  # kind auto-detected
         assert out.exists() and out.stat().st_size > 5000, kind
+
+
+def test_plot_appended_csv_uses_latest_run(tmp_path):
+    """The documented flow APPENDS rows across runs; plots must render the
+    latest sweep, not a zigzag across all of them."""
+    import csv as csv_mod
+
+    from distributed_pytorch_training_tpu.experiments import plots
+
+    run1 = [{"per_device_batch": b, "global_samples_per_s": v}
+            for b, v in ((32, 10.0), (64, 20.0))]
+    run2 = [{"per_device_batch": b, "global_samples_per_s": v}
+            for b, v in ((32, 11.0), (64, 22.0))]
+    path = tmp_path / "batch.csv"
+    with open(path, "w", newline="") as f:
+        w = csv_mod.DictWriter(f, fieldnames=["per_device_batch",
+                                              "global_samples_per_s"])
+        w.writeheader()
+        w.writerows(run1 + run2)
+
+    rows = plots._latest(plots._read(str(path)), "batch")
+    assert [r["global_samples_per_s"] for r in rows] == ["11.0", "22.0"]
+    out = tmp_path / "b.png"
+    plots.main([str(path), "--out", str(out)])
+    assert out.exists() and out.stat().st_size > 5000
