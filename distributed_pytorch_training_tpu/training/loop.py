@@ -43,6 +43,11 @@ class TrainConfig:
     seed: int = 42
     bf16: bool = False  # the --amp equivalent (ref :36-37)
     donate_state: bool = True
+    # Gradient accumulation: split each global batch into this many
+    # microbatches inside the jitted step (lax.scan), summing weighted
+    # gradients — reference-scale global batches on few chips at
+    # 1/grad_accum the activation memory. 1 = off.
+    grad_accum: int = 1
 
 
 class Trainer:
@@ -82,15 +87,77 @@ class Trainer:
 
     def _train_step_impl(self, state: TrainState, batch, epoch_key):
         rng = jax.random.fold_in(epoch_key, state.step)
+        accum = self.config.grad_accum
 
-        def loss_fn(params):
-            return self.task.loss_and_metrics(state, params, batch, rng, train=True)
+        if accum <= 1:
+            def loss_fn(params):
+                return self.task.loss_and_metrics(state, params, batch, rng,
+                                                  train=True)
 
-        grads, (metrics, new_stats) = jax.grad(loss_fn, has_aux=True)(state.params)
-        # No explicit all-reduce: grads of a loss over the data-sharded global
-        # batch are already the synchronized gradients (the DDP reducer's job,
-        # ref :305-310, done by XLA layout propagation).
-        new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            grads, (metrics, new_stats) = jax.grad(
+                loss_fn, has_aux=True)(state.params)
+            # No explicit all-reduce: grads of a loss over the data-sharded
+            # global batch are already the synchronized gradients (the DDP
+            # reducer's job, ref :305-310, done by XLA layout propagation).
+            new_state = state.apply_gradients(grads, batch_stats=new_stats)
+            return new_state, metrics
+
+        # -- gradient accumulation ----------------------------------------
+        # The task loss is the weighted MEAN over its (micro)batch, so the
+        # global-batch gradient is the weight-proportional combination:
+        #   d(global mean)/dθ = Σ_i (w_i / W) · d(mean_i)/dθ.
+        # We accumulate w_i-scaled microbatch grads in the scan carry and
+        # divide by W once — bit-comparable (up to fp reassociation) to the
+        # unaccumulated step on the same global batch.
+        if jax.tree_util.tree_leaves(state.batch_stats):
+            raise ValueError(
+                "grad_accum > 1 does not support batch-stats models "
+                "(BatchNorm EMAs would update per microbatch); use a "
+                "stat-free model or grad_accum=1")
+
+        def split(x):
+            if x.ndim == 0:
+                return jnp.broadcast_to(x, (accum,))
+            if x.shape[0] % accum:
+                raise ValueError(
+                    f"global batch {x.shape[0]} not divisible by "
+                    f"grad_accum={accum}")
+            # INTERLEAVED split (microbatch i = rows i::accum), not
+            # contiguous blocks: the batch is sharded over the data axes by
+            # contiguous row ranges, so a contiguous microbatch would live
+            # on 1/accum of the devices and every scan step would reshard.
+            # Strided microbatches stay evenly spread over all shards.
+            return x.reshape(x.shape[0] // accum, accum,
+                             *x.shape[1:]).swapaxes(0, 1)
+
+        micro_batches = jax.tree_util.tree_map(split, batch)
+
+        def micro_grads(mb, key):
+            def loss_fn(params):
+                return self.task.loss_and_metrics(state, params, mb, key,
+                                                  train=True)
+
+            return jax.grad(loss_fn, has_aux=True)(state.params)
+
+        def body(carry, xs):
+            g_sum, m_sum = carry
+            mb, key = xs
+            g, (m, _) = micro_grads(mb, key)
+            w = m["weight"]
+            g_sum = jax.tree_util.tree_map(
+                lambda a, b: a + w * b.astype(a.dtype), g_sum, g)
+            m_sum = add_metrics(m_sum, m)
+            return (g_sum, m_sum), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        keys = jax.random.split(rng, accum)
+        (g_sum, metrics), _ = jax.lax.scan(
+            body, (g0, zero_metrics()), (micro_batches, keys))
+        total_w = jnp.maximum(metrics["weight"], 1.0)
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / total_w).astype(p.dtype), g_sum, state.params)
+        new_state = state.apply_gradients(grads)
         return new_state, metrics
 
     def _eval_step_impl(self, state: TrainState, batch):

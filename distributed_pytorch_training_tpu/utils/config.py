@@ -71,6 +71,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "xla einsum, Pallas flash kernel, ring (KV "
                              "rotation over the mesh seq axis), or ulysses "
                              "(all-to-all head sharding over seq)")
+    parser.add_argument("--grad-accum", default=1, type=int,
+                        help="gradient accumulation: microbatches per "
+                             "optimizer step inside the jitted step "
+                             "(reference-scale global batches on few chips)")
     parser.add_argument("--remat", action="store_true",
                         help="gradient checkpointing: recompute each "
                              "transformer block in the backward pass "

@@ -280,3 +280,88 @@ class TestLMTasks:
         batch = shard_batch({"input_ids": ids, "weight": w}, mesh8)
         m = trainer._eval_step(state, batch)
         assert float(m["weight"]) == 8 * 15  # 8 real rows x (seq-1) targets
+
+
+class TestGradAccumulation:
+    """grad_accum=k must reproduce the unaccumulated step on the same global
+    batch: the weighted-grad combination d(global mean) = sum_i (w_i/W)
+    d(mean_i) is exact, not an approximation."""
+
+    def _setup(self, mesh, accum, lr=1e-2):
+        from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+        from distributed_pytorch_training_tpu.training import (
+            TrainConfig, Trainer,
+        )
+        from distributed_pytorch_training_tpu.training.optim import sgd
+        from distributed_pytorch_training_tpu.training.tasks import (
+            LanguageModelingTask,
+        )
+
+        model = GPT2LMHead(vocab_size=64, hidden_dim=32, depth=2, num_heads=2,
+                           max_position=16)
+        t = Trainer(LanguageModelingTask(), mesh,
+                    TrainConfig(seed=0, grad_accum=accum))
+        state = t.init_state(model, np.zeros((1, 16), np.int32), sgd(lr),
+                             jax.random.PRNGKey(0))
+        return t, state
+
+    def _batch(self, mesh, n=16):
+        from distributed_pytorch_training_tpu.parallel import shard_batch
+
+        rng = np.random.RandomState(0)
+        w = np.ones(n, np.float32)
+        w[-3:] = 0.0  # padding rows: the weighted combination must be exact
+        return shard_batch({
+            "input_ids": rng.randint(0, 64, (n, 16)).astype(np.int32),
+            "weight": w,
+        }, mesh)
+
+    def test_accum_matches_unaccumulated(self, mesh8):
+        batch = self._batch(mesh8)
+        key = jax.random.PRNGKey(1)
+        t1, s1 = self._setup(mesh8, accum=1)
+        t4, s4 = self._setup(mesh8, accum=4)
+        s1n, m1 = t1._train_step(s1, batch, key)
+        s4n, m4 = t4._train_step(s4, batch, key)
+        np.testing.assert_allclose(float(m1["loss_sum"]),
+                                   float(m4["loss_sum"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m1["weight"]), float(m4["weight"]))
+        # updated params identical (same grads -> same SGD step)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+            jax.device_get(s1n.params), jax.device_get(s4n.params))
+
+    def test_accum_rejects_batch_stats_models(self, mesh8):
+        from distributed_pytorch_training_tpu.data import (
+            CIFAR10_MEAN, CIFAR10_STD,
+        )
+        from distributed_pytorch_training_tpu.models import get_model
+        from distributed_pytorch_training_tpu.parallel import shard_batch
+        from distributed_pytorch_training_tpu.training import (
+            TrainConfig, Trainer,
+        )
+        from distributed_pytorch_training_tpu.training.optim import sgd
+        from distributed_pytorch_training_tpu.training.tasks import (
+            ImageClassificationTask,
+        )
+
+        model = get_model("resnet18", num_classes=10)  # BatchNorm stats
+        t = Trainer(ImageClassificationTask(mean=CIFAR10_MEAN,
+                                            std=CIFAR10_STD),
+                    mesh8, TrainConfig(seed=0, grad_accum=2))
+        state = t.init_state(model, np.zeros((1, 32, 32, 3), np.float32),
+                             sgd(0.1), jax.random.PRNGKey(0))
+        batch = shard_batch({
+            "image": np.zeros((16, 32, 32, 3), np.uint8),
+            "label": np.zeros(16, np.int32),
+            "weight": np.ones(16, np.float32),
+        }, mesh8)
+        with pytest.raises(ValueError, match="batch-stats"):
+            t._train_step(state, batch, jax.random.PRNGKey(1))
+
+    def test_accum_rejects_indivisible_batch(self, mesh8):
+        t, state = self._setup(mesh8, accum=3)
+        batch = self._batch(mesh8, n=16)  # 16 % 3 != 0
+        with pytest.raises(ValueError, match="not divisible"):
+            t._train_step(state, batch, jax.random.PRNGKey(1))

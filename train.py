@@ -251,7 +251,7 @@ def main(argv=None):
     trainer = Trainer(task, mesh,
                       TrainConfig(per_device_batch=args.batch_size,
                                   print_freq=args.print_freq, seed=args.seed,
-                                  bf16=args.amp),
+                                  bf16=args.amp, grad_accum=args.grad_accum),
                       rules=rules)
 
     state = trainer.init_state(model, sample_input, tx,
