@@ -107,8 +107,19 @@ class Trainer:
         # global-batch gradient is the weight-proportional combination:
         #   d(global mean)/dθ = Σ_i (w_i / W) · d(mean_i)/dθ.
         # We accumulate w_i-scaled microbatch grads in the scan carry and
-        # divide by W once — bit-comparable (up to fp reassociation) to the
-        # unaccumulated step on the same global batch.
+        # divide by W once.
+        #
+        # Equivalence scope (vs the unaccumulated step on the same batch):
+        # EXACT (up to fp reassociation) for deterministic per-sample losses
+        # (causal LM with dropout 0 — the parity test). NOT bit-equal for:
+        # * stochastic tasks (MLM masking, dropout, augmentation): each
+        #   microbatch gets its own fold of the step RNG, so different
+        #   positions mask — still an unbiased step, just a different draw;
+        # * batch-statistic auxiliary losses (MoE load balancing): the
+        #   accumulated objective is the w_i/W-weighted combination of
+        #   per-microbatch aux losses, whereas grad_accum=1 computes routing
+        #   statistics over the full batch. Inherent to accumulation, not a
+        #   bug — per-microbatch balancing is itself a valid regularizer.
         if jax.tree_util.tree_leaves(state.batch_stats):
             raise ValueError(
                 "grad_accum > 1 does not support batch-stats models "

@@ -284,8 +284,10 @@ class TestLMTasks:
 
 class TestGradAccumulation:
     """grad_accum=k must reproduce the unaccumulated step on the same global
-    batch: the weighted-grad combination d(global mean) = sum_i (w_i/W)
-    d(mean_i) is exact, not an approximation."""
+    batch for DETERMINISTIC per-sample losses: the weighted-grad combination
+    d(global mean) = sum_i (w_i/W) d(mean_i) is exact, not an approximation.
+    (Stochastic tasks and batch-statistic aux losses are unbiased but not
+    bit-equal — see the equivalence-scope note in loop.py.)"""
 
     def _setup(self, mesh, accum, lr=1e-2):
         from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
@@ -365,3 +367,54 @@ class TestGradAccumulation:
         batch = self._batch(mesh8, n=16)  # 16 % 3 != 0
         with pytest.raises(ValueError, match="not divisible"):
             t._train_step(state, batch, jax.random.PRNGKey(1))
+
+
+class TestSeedDeterminism:
+    """SURVEY §4: same seed -> identical training trajectory (the
+    reproducibility contract behind ref set_seed, train_ddp.py:76-78/:319);
+    different seed -> different trajectory (the seed actually reaches the
+    stochastic parts: init, augmentation, shuffle)."""
+
+    def _run(self, mesh, seed, steps=4):
+        from distributed_pytorch_training_tpu.data import (
+            CIFAR10_MEAN, CIFAR10_STD,
+        )
+        from distributed_pytorch_training_tpu.models import get_model
+        from distributed_pytorch_training_tpu.parallel import shard_batch
+        from distributed_pytorch_training_tpu.training import (
+            TrainConfig, Trainer,
+        )
+        from distributed_pytorch_training_tpu.training.optim import sgd
+        from distributed_pytorch_training_tpu.training.tasks import (
+            ImageClassificationTask,
+        )
+
+        model = get_model("resnet18", num_classes=10)
+        t = Trainer(ImageClassificationTask(mean=CIFAR10_MEAN,
+                                            std=CIFAR10_STD, augment=True),
+                    mesh, TrainConfig(seed=seed))
+        state = t.init_state(model, np.zeros((1, 32, 32, 3), np.float32),
+                             sgd(0.1, momentum=0.9),
+                             jax.random.PRNGKey(seed))
+        rng = np.random.RandomState(0)  # DATA fixed; only framework seed varies
+        batch = shard_batch({
+            "image": rng.randint(0, 256, (16, 32, 32, 3)).astype(np.uint8),
+            "label": rng.randint(0, 10, 16).astype(np.int32),
+            "weight": np.ones(16, np.float32),
+        }, mesh)
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), 0)
+        losses = []
+        for _ in range(steps):
+            state, m = t._train_step(state, batch, key)
+            losses.append(float(m["loss_sum"]))
+        return losses
+
+    def test_same_seed_identical_trajectory(self, mesh8):
+        a = self._run(mesh8, seed=42)
+        b = self._run(mesh8, seed=42)
+        np.testing.assert_array_equal(a, b)  # bit-identical, not just close
+
+    def test_different_seed_different_trajectory(self, mesh8):
+        a = self._run(mesh8, seed=42)
+        c = self._run(mesh8, seed=43)
+        assert a != c
