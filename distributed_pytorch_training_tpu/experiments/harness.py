@@ -51,21 +51,40 @@ def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
     return trainer, state, mesh
 
 
+def is_lm_model(model_name: str) -> bool:
+    """One source of truth for the image-vs-LM dispatch (bench + drivers)."""
+    return model_name.startswith(("gpt2", "bert"))
+
+
+def lm_vocab(model_name: str) -> int:
+    return 30522 if model_name.startswith("bert") else 50257
+
+
 def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
-                     model_name: str, seq_len: int):
+                     model_name: str, seq_len: int,
+                     model_kwargs: Optional[dict] = None):
     """(trainer, state, mesh) for a language-model config (gpt2_*/bert_base,
-    BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes."""
+    BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes.
+    `model_kwargs` overrides architecture fields (CI smoke runs shrink the
+    model; benchmarks use the real sizes)."""
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
     from ..training import TrainConfig, Trainer
     from ..training.optim import adamw
-    from ..training.tasks import LanguageModelingTask, MaskedLMTask
+    from ..training.tasks import (
+        LanguageModelingTask, MaskedLMTask, MoeLanguageModelingTask,
+    )
 
     mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
     dtype = jnp.bfloat16 if bf16 else jnp.float32
-    model = get_model(model_name, dtype=dtype, max_position=max(seq_len, 512))
+    model = get_model(model_name, dtype=dtype, max_position=max(seq_len, 512),
+                      **(model_kwargs or {}))
     if model_name.startswith("bert"):
         task = MaskedLMTask(compute_dtype=dtype)
+    elif "moe" in model_name:
+        # measuring an MoE step without the router load-balancing loss
+        # would time a step nobody trains
+        task = MoeLanguageModelingTask(compute_dtype=dtype)
     else:
         task = LanguageModelingTask(compute_dtype=dtype)
     trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16),
@@ -73,6 +92,29 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
     state = trainer.init_state(model, np.zeros((1, seq_len), np.int32),
                                adamw(1e-4), jax.random.PRNGKey(0))
     return trainer, state, mesh
+
+
+def build_trainer(devices: Sequence[jax.Device], bf16: bool, model_name: str,
+                  seq_len: int = 512, image_hw: int = 32,
+                  num_classes: int = 10,
+                  lm_overrides: Optional[dict] = None):
+    """Model-family dispatch used by bench.py AND the experiment drivers —
+    the same `--model` string must measure the same config everywhere."""
+    if is_lm_model(model_name):
+        return build_lm_trainer(devices, bf16, model_name, seq_len,
+                                lm_overrides)
+    return build_image_trainer(devices, bf16, model_name, image_hw,
+                               num_classes)
+
+
+def make_synth_batch(mesh, model_name: str, per_device_batch: int,
+                     seq_len: int = 512, image_hw: int = 32,
+                     num_classes: int = 10):
+    """(sharded batch, global batch) matching `build_trainer`'s config."""
+    if is_lm_model(model_name):
+        return synth_token_batch(mesh, per_device_batch, seq_len,
+                                 lm_vocab(model_name))
+    return synth_image_batch(mesh, per_device_batch, image_hw, num_classes)
 
 
 def synth_image_batch(mesh, per_device_batch: int, image_hw: int = 32,
@@ -207,24 +249,16 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     from . import flops as flops_mod
 
     devices = list(devices) if devices is not None else jax.devices()
-    is_lm = model_name.startswith(("gpt2", "bert"))
+    is_lm = is_lm_model(model_name)
 
     ctx = (jax.default_matmul_precision("highest")
            if (not bf16 and true_fp32) else contextlib.nullcontext())
     with ctx:
-        if is_lm:
-            trainer, state, mesh = build_lm_trainer(devices, bf16, model_name,
-                                                    seq_len)
-            vocab = 30522 if model_name.startswith("bert") else 50257
-            batch, global_batch = synth_token_batch(mesh, per_device_batch,
-                                                    seq_len, vocab)
-        else:
-            trainer, state, mesh = build_image_trainer(
-                devices, bf16, model_name, image_hw=image_hw,
-                num_classes=num_classes)
-            batch, global_batch = synth_image_batch(
-                mesh, per_device_batch, image_hw=image_hw,
-                num_classes=num_classes)
+        trainer, state, mesh = build_trainer(
+            devices, bf16, model_name, seq_len, image_hw, num_classes)
+        batch, global_batch = make_synth_batch(
+            mesh, model_name, per_device_batch, seq_len, image_hw,
+            num_classes)
 
         key = jax.random.PRNGKey(0)
         # AOT-compile once: cost analysis reads the exact executable we time.

@@ -49,19 +49,38 @@ honor_platform_env()  # allow JAX_PLATFORMS=cpu virtual-mesh runs
 
 
 # One measurement harness shared with bench.py (experiments/harness.py) so
-# the headline bench and these tables stay comparable.
-from .harness import build_image_trainer as _build_trainer  # noqa: E402
-from .harness import synth_image_batch, timed_steps  # noqa: E402
+# the headline bench and these tables stay comparable — including the
+# image-vs-LM dispatch (harness.build_trainer / make_synth_batch), so the
+# same --model string measures the same config everywhere.
+from .harness import build_trainer, is_lm_model, make_synth_batch, timed_steps  # noqa: E402
+
+# CI smoke runs shrink LM architectures (full-size bert/gpt2 on the CPU test
+# mesh costs minutes per build); real measurements never set this.
+_LM_TINY = dict(hidden_dim=64, depth=2, num_heads=2, mlp_dim=128)
 
 
-def _measure(trainer, state, mesh, per_device_batch: int,
-             steps: int, repeats: int = 3,
-             min_window_s: float = 0.5) -> Tuple[float, float]:
+def _setup(devices, bf16: bool, args, per_device_batch=None):
+    """(trainer, state, mesh, batch, global_batch) for args' config — the
+    trainer and its batch are built together so they can never mismatch."""
+    lm_kw = None
+    if args.lm_tiny and is_lm_model(args.model):
+        lm_kw = dict(_LM_TINY)
+        if args.model.startswith("gpt2"):
+            lm_kw.pop("mlp_dim")  # gpt2 derives mlp from hidden_dim
+    trainer, state, mesh = build_trainer(devices, bf16, args.model,
+                                         args.seq_len, lm_overrides=lm_kw)
+    batch, gb = make_synth_batch(mesh, args.model,
+                                 per_device_batch or args.batch_size,
+                                 args.seq_len)
+    return trainer, state, mesh, batch, gb
+
+
+def _measure(trainer, state, batch, global_batch: int, args) -> Tuple[float, float]:
     """(steps/sec, samples/sec) for the jitted train step."""
-    batch, global_batch = synth_image_batch(mesh, per_device_batch)
     sps, samples = timed_steps(trainer._train_step, state, batch,
-                               global_batch, steps, repeats=repeats,
-                               min_window_s=min_window_s)
+                               global_batch, args.steps,
+                               repeats=args.repeats,
+                               min_window_s=args.min_window_s)
     return sps, samples
 
 
@@ -96,10 +115,8 @@ def run_scaling(args) -> List[dict]:
     rows = []
     base = None
     for c in counts:
-        trainer, state, mesh = _build_trainer(devices[:c], args.bf16,
-                                              args.model)
-        _, sps = _measure(trainer, state, mesh, args.batch_size,
-                              args.steps, args.repeats, args.min_window_s)
+        trainer, state, _, batch, gb = _setup(devices[:c], args.bf16, args)
+        _, sps = _measure(trainer, state, batch, gb, args)
         base = base or sps
         rows.append({
             "chips": c,
@@ -116,9 +133,9 @@ def run_batch_sweep(args) -> List[dict]:
     batches = (tuple(int(b) for b in args.batch_list.split(","))
                if args.batch_list else (32, 64, 128, 256, 512))
     for b in batches:
-        trainer, state, mesh = _build_trainer(devices, args.bf16, args.model)
-        _, sps = _measure(trainer, state, mesh, b, args.steps, args.repeats,
-                          args.min_window_s)
+        trainer, state, _, batch, gb = _setup(devices, args.bf16, args,
+                                              per_device_batch=b)
+        _, sps = _measure(trainer, state, batch, gb, args)
         rows.append({"per_device_batch": b,
                      "global_samples_per_s": round(sps, 1)})
     return rows
@@ -129,9 +146,8 @@ def run_amp(args) -> List[dict]:
     rows = []
     sps_by_prec = {}
     for bf16 in (False, True):
-        trainer, state, mesh = _build_trainer(devices, bf16, args.model)
-        _, sps = _measure(trainer, state, mesh, args.batch_size,
-                              args.steps, args.repeats, args.min_window_s)
+        trainer, state, _, batch, gb = _setup(devices, bf16, args)
+        _, sps = _measure(trainer, state, batch, gb, args)
         sps_by_prec[bf16] = sps
         rows.append({"precision": "bf16" if bf16 else "fp32",
                      "global_samples_per_s": round(sps, 1)})
@@ -174,34 +190,22 @@ def run_gradsync(args) -> List[dict]:
     rows = []
 
     # (a) measured: constant per-device batch, 1 chip vs N chips
-    trainer1, state1, mesh1 = _build_trainer(devices[:1], args.bf16, args.model)
-    step1, _ = _measure(trainer1, state1, mesh1, args.batch_size, args.steps,
-                          args.repeats, args.min_window_s)
+    trainer1, state1, _, batch1, gb1 = _setup(devices[:1], args.bf16, args)
+    step1, _ = _measure(trainer1, state1, batch1, gb1, args)
     t1 = 1.0 / step1
     rows.append({"measurement": "step_time_1chip_ms", "value": round(t1 * 1e3, 3)})
     if n > 1:
-        trainerN, stateN, meshN = _build_trainer(devices, args.bf16, args.model)
+        trainerN, stateN, _, batchN, gbN = _setup(devices, args.bf16, args)
 
         # (b) static: collective census of the compiled N-chip step.
         # Lower/compile BEFORE the timed run: _measure runs the donating
         # jitted step on stateN, after which its buffers are deleted on
         # backends that honor donation (TPU) — lowering afterwards would
         # depend on donated-away state (ADVICE r1).
-        from ..parallel import shard_batch
-        from ..parallel.mesh import batch_shard_count
-
-        gb = args.batch_size * batch_shard_count(meshN)
-        rng = np.random.RandomState(0)
-        batch = shard_batch({
-            "image": rng.randint(0, 256, (gb, 32, 32, 3)).astype(np.uint8),
-            "label": rng.randint(0, 10, gb).astype(np.int32),
-            "weight": np.ones(gb, np.float32),
-        }, meshN)
         compiled = trainerN._train_step.lower(
-            stateN, batch, jax.random.PRNGKey(0)).compile()
+            stateN, batchN, jax.random.PRNGKey(0)).compile()
 
-        stepN, _ = _measure(trainerN, stateN, meshN, args.batch_size,
-                                args.steps, args.repeats, args.min_window_s)
+        stepN, _ = _measure(trainerN, stateN, batchN, gbN, args)
         tN = 1.0 / stepN
         share = max(0.0, 1.0 - t1 / tN)
         rows.append({"measurement": f"step_time_{n}chip_ms",
@@ -216,9 +220,7 @@ def run_gradsync(args) -> List[dict]:
 
         from .trace_analysis import capture_step_trace, collective_share
 
-        trainerT, stateT, meshT = _build_trainer(devices, args.bf16,
-                                                 args.model)
-        batchT, _ = synth_image_batch(meshT, args.batch_size)
+        trainerT, stateT, _, batchT, _gbT = _setup(devices, args.bf16, args)
         keyT = jax.random.PRNGKey(0)
         stateT, _ = trainerT._train_step(stateT, batchT, keyT)  # warmup
         with tempfile.TemporaryDirectory(prefix="gradsync_trace_") as td:
@@ -334,6 +336,13 @@ def main(argv=None):
     p.add_argument("--batch-list", default=None, type=str,
                    help="comma-separated per-device batches for the 'batch' "
                         "sweep (default 32,64,128,256,512)")
+    p.add_argument("--lm-tiny", action="store_true",
+                   help="shrink LM architectures for CI smoke runs "
+                        "(never use for real measurements)")
+    p.add_argument("--seq-len", default=512, type=int,
+                   help="sequence length for LM models (--model gpt2_*/"
+                        "bert_base; e.g. the BERT-512 grad-sync profiling "
+                        "run, BASELINE config 4)")
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--csv", default=None,
                    help="append rows to this CSV (plots regenerate from it)")

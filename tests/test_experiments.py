@@ -180,3 +180,16 @@ def test_plot_appended_csv_uses_latest_run(tmp_path):
     out = tmp_path / "b.png"
     plots.main([str(path), "--out", str(out)])
     assert out.exists() and out.stat().st_size > 5000
+
+
+def test_experiment_gradsync_bert_smoke(capsys):
+    """The BASELINE matrix's config 4 is 'BERT-base MLM seq-len 512
+    (grad-sync profiling run)' — the gradsync driver must serve LM models,
+    not only the image configs (tiny shapes here; real seq on hardware)."""
+    from distributed_pytorch_training_tpu.experiments import scaling
+    scaling.main(["gradsync", "--model", "bert_base", "--seq-len", "64",
+                  "--batch-size", "2", "--steps", "1", "--repeats", "1",
+                  "--min-window-s", "0.01", "--lm-tiny"])
+    out = capsys.readouterr().out
+    assert "grad_sync_share_trace_pct" in out
+    assert "all-reduce" in out
