@@ -40,9 +40,21 @@ def random_crop_flip(
     """RandomCrop(H, padding) + RandomHorizontalFlip, vectorized over the
     batch (ref :92-93). Input NHWC (any numeric dtype); output same shape.
 
-    Implementation notes for XLA: per-sample crop offsets become one
-    `dynamic_slice` per sample under `vmap` — static output shapes, fully
-    fusable, no data-dependent control flow.
+    Implementation notes for XLA/TPU: the per-sample crop is expressed as two
+    batched one-hot matmuls (row select, then column select), NOT a gather.
+    A batched 3-index gather here compiles to a u8[N*H*W, C] kernel whose
+    C-wide minor dimension wastes 125 of 128 vector lanes — measured 16 ms of
+    a 21 ms ResNet-18 step at batch 2048 on a v5e chip, ~70% of step time.
+    The one-hot selection rides the MXU instead (<0.1 ms) and is *bit-exact*:
+    every output element is dot(one_hot_row, values) with exactly one nonzero
+    0/1 weight, so no rounding occurs for uint8/int inputs even in a bf16
+    pass (0..255 are exactly representable: 8 significand bits). The flip is
+    folded into the column-selection indices (reversed per flipped sample),
+    so crop+flip is still just the two matmuls. Wider dtypes select through a
+    float32 HIGHEST pass: exact for integer values up to 2^24. Two caveats vs
+    a gather: integers beyond 2^24 round, and a non-finite pixel (inf/NaN
+    sentinel) contaminates its whole row/column of the contraction — feed
+    finite pixel data.
     """
     n, h, w, c = images.shape
     key_crop_h, key_crop_w, key_flip = jax.random.split(key, 3)
@@ -53,13 +65,28 @@ def random_crop_flip(
     )
     off_h = jax.random.randint(key_crop_h, (n,), 0, 2 * padding + 1)
     off_w = jax.random.randint(key_crop_w, (n,), 0, 2 * padding + 1)
+    flip = jax.random.bernoulli(key_flip, flip_prob, (n,))
 
-    # Per-sample crop as ONE batched gather (advanced indexing), not a
-    # vmap'd dynamic_slice: compile time stays O(1) in batch size (the
-    # slice form made XLA compile minutes-long programs at batch >= 2048).
-    rows = off_h[:, None] + jnp.arange(h)[None, :]           # (N, h)
-    cols = off_w[:, None] + jnp.arange(w)[None, :]           # (N, w)
-    cropped = padded[jnp.arange(n)[:, None, None],
-                     rows[:, :, None], cols[:, None, :]]     # (N, h, w, C)
-    flip = jax.random.bernoulli(key_flip, flip_prob, (n, 1, 1, 1))
-    return jnp.where(flip, cropped[:, :, ::-1, :], cropped)
+    # bf16 pass only for dtypes whose values it represents exactly (8-bit
+    # ints: 0..255 fit in bf16's 8 significand bits; bf16 itself). Wider
+    # ints / other floats select in float32 under HIGHEST so e.g. uint16
+    # sensor values survive bit-exact (exact up to 2^24).
+    if images.dtype in (jnp.uint8, jnp.int8, jnp.bfloat16):
+        sel_dtype, precision = jnp.bfloat16, jax.lax.Precision.DEFAULT
+    else:
+        sel_dtype, precision = jnp.float32, jax.lax.Precision.HIGHEST
+
+    hp, wp = h + 2 * padding, w + 2 * padding
+    rows = jax.nn.one_hot(off_h[:, None] + jnp.arange(h), hp,
+                          dtype=sel_dtype)                   # (N, h, HP)
+    # Horizontal flip ≙ selecting columns in reverse order: applied on the
+    # (N, w) index array, free on the (N, h, w, C) images.
+    col_idx = jnp.where(flip[:, None],
+                        off_w[:, None] + (w - 1) - jnp.arange(w),
+                        off_w[:, None] + jnp.arange(w))
+    cols = jax.nn.one_hot(col_idx, wp, dtype=sel_dtype)      # (N, w, WP)
+
+    x = jnp.einsum("nhp,npwc->nhwc", rows, padded.astype(sel_dtype),
+                   precision=precision)
+    x = jnp.einsum("nwp,nhpc->nhwc", cols, x, precision=precision)
+    return x.astype(images.dtype)

@@ -120,6 +120,23 @@ class TestAugment:
         c = random_crop_flip(imgs, jax.random.PRNGKey(1))
         assert not np.array_equal(np.asarray(a), np.asarray(c))
 
+    def test_crop_flip_is_pure_selection_every_dtype(self):
+        # The one-hot-matmul crop must be bit-exact pure selection: every
+        # output pixel appears verbatim in the zero-padded input, including
+        # dtypes wider than the bf16 selection pass can represent (uint16 /
+        # int32 values > 256 route through the f32 HIGHEST pass).
+        rs = np.random.RandomState(3)
+        for dtype, hi in ((np.uint8, 256), (np.uint16, 60000),
+                          (np.int32, 1 << 20), (np.float32, 1 << 20)):
+            raw = rs.randint(0, hi, (4, 8, 8, 3)).astype(dtype)
+            if dtype == np.float32:
+                raw += rs.rand(*raw.shape).astype(np.float32)
+            out = np.asarray(random_crop_flip(jnp.asarray(raw),
+                                              jax.random.PRNGKey(5)))
+            assert out.dtype == dtype
+            allowed = set(raw.reshape(-1).tolist()) | {0}
+            assert set(out.reshape(-1).tolist()) <= allowed, dtype
+
     def test_crop_content_preserved_without_padding_region(self):
         # zero padding: crop offsets can pull in zeros; flip only mirrors.
         imgs = jnp.ones((4, 8, 8, 3), jnp.float32)
