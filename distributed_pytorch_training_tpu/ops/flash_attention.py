@@ -49,6 +49,30 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _fit_block(requested: int, s: int) -> int:
+    """Largest legal block size <= `requested` for a length-`s` axis.
+
+    TPU lowering needs the sublane block dim divisible by 8 (or spanning the
+    whole axis), and pallas grids need block | s. Prefers the largest
+    divisor of s that is a multiple of 8 and <= requested; falls back to the
+    full axis (always legal). 512 beat 128/256 on v5e for GPT-2 @ S=1024
+    (90.7 vs 143.5 / 109.6 ms per train step), hence the public default."""
+    b = min(requested, s)
+    if s % b == 0 and (b % 8 == 0 or b == s):
+        return b
+    for cand in range(b - b % 8, 7, -8):
+        if s % cand == 0:
+            return cand
+    # No multiple-of-8 divisor (s % 8 != 0): spanning the axis is the only
+    # legal block, acceptable for short sequences but it would forfeit the
+    # blockwise VMEM bound for long ones — fail loudly there instead.
+    if s > 1024:
+        raise ValueError(
+            f"flash_attention: sequence length {s} has no block size that "
+            f"is a multiple of 8; pad the sequence to a multiple of 8")
+    return s
+
+
 def _reference_attention(q, k, v, causal: bool, sm_scale: float):
     """XLA einsum attention — the parity oracle for tests."""
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * sm_scale
@@ -104,32 +128,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     def _finalize():
         l = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[...] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = (m_scr[...] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
                    block_q: int, block_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (out (BH, Sq, d) folded back to (B, Sq, H, d), lse (BH, Sq))."""
+    """Returns (out (BH, Sq, d) folded back to (B, Sq, H, d), lse (BH, 1, Sq))."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"flash_attention: seq lengths ({sq}, {sk}) must be divisible by "
-            f"block sizes ({block_q}, {block_k})")
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
 
     grid = (b * h, sq // block_q, sk // block_k)
+    # lse rides as (BH, 1, Sq): a 2-D (BH, Sq) output with block (1, block_q)
+    # violates the TPU lowering rule that the second-to-last block dim be
+    # divisible by 8 or span the array dim; the singleton middle axis spans
+    # its dim, making the (1, 1, block_q) block legal on hardware.
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, sm_scale=sm_scale),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         grid=grid,
         in_specs=[
@@ -139,7 +163,7 @@ def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -176,8 +200,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)                  # (bk, d)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)                # (bq, d)
-        lse = lse_ref[0][:, None]                         # (bq, 1)
-        delta = delta_ref[0][:, None]                     # (bq, 1)
+        lse = lse_ref[0, 0][:, None]                      # (bq, 1)
+        delta = delta_ref[0, 0][:, None]                  # (bq, 1)
         s = sm_scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
@@ -216,8 +240,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = sm_scale * jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
             rows = qb * block_q + jax.lax.broadcasted_iota(
@@ -239,19 +263,21 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
                block_q: int, block_k: int):
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
 
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     dof = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     of = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term
-    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
+    # (BH, 1, Sq) like lse so its (1, 1, block_q) block lowers on TPU.
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)[:, None, :]
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, j, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, j))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, j))
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                           causal=causal, sm_scale=sm_scale),
@@ -290,8 +316,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -316,8 +342,8 @@ def flash_attention(
     v: jnp.ndarray,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Blockwise attention; numerically equivalent to softmax(QK^T*scale)V."""
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
@@ -340,7 +366,7 @@ def _vjp_bwd(causal, sm_scale, block_q, block_k, residuals, g):
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
-def make_flash_attention_fn(causal: bool, block_q: int = 128, block_k: int = 128):
+def make_flash_attention_fn(causal: bool, block_q: int = 512, block_k: int = 512):
     """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`.
 
     The mask argument must be None (padding masks need the XLA path); causal

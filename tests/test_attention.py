@@ -42,10 +42,22 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    rtol=2e-5, atol=2e-5)
 
-    def test_uneven_blocks_raise(self):
+    def test_uneven_blocks_auto_fit(self):
+        # 100 has no divisor that is a multiple of 8, so the block picker
+        # falls back to spanning the axis — still correct, never an error.
         q, k, v = _rand_qkv(s=100)
-        with pytest.raises(ValueError, match="divisible"):
-            flash_attention(q, k, v, False, None, 64, 64)
+        out = flash_attention(q, k, v, False, None, 64, 64)
+        expect = _ref(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+        # 96 = 12 blocks of 8: picker takes the largest <=64 divisor (48).
+        from distributed_pytorch_training_tpu.ops.flash_attention import (
+            _fit_block,
+        )
+        assert _fit_block(64, 96) == 48
+        assert _fit_block(64, 100) == 100
+        assert _fit_block(512, 1024) == 512
+        assert _fit_block(512, 384) == 384
 
     def test_gradients_match_reference(self):
         q, k, v = _rand_qkv(b=1, s=64, h=2, d=16)
