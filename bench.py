@@ -85,11 +85,10 @@ def _parse(argv):
     p = argparse.ArgumentParser()
     p.add_argument("--batch-size", default=4096, type=int,
                    help="per-device batch for the ResNet headline; 4096 "
-                        "saturates the chip on CIFAR shapes and amortizes "
-                        "the tunneled dispatch gap (~1.5 ms/step) — measured "
-                        "311k/420k/413k samples/s at 2048/4096/8192 on v5e "
-                        "(the reference default 128 is dispatch-bound — see "
-                        "experiments 'batch')")
+                        "saturates the chip on CIFAR shapes, ~13% over 2048 "
+                        "— 466-471k samples/s/chip on v5e by this bench's "
+                        "differenced-window measure (the reference default "
+                        "128 is dispatch-bound — see experiments 'batch')")
     p.add_argument("--steps", default=20, type=int)
     p.add_argument("--repeats", default=3, type=int)
     p.add_argument("--quick", action="store_true",
@@ -206,6 +205,9 @@ def _bench(args):
                              num_classes=1000, steps=10)),
             ("gpt2_124m", dict(per_device_batch=8, seq_len=1024, steps=10)),
             ("bert_base", dict(per_device_batch=16, seq_len=512, steps=10)),
+            # long-context (flash kernels) and expert-parallel coverage
+            ("gpt2_124m", dict(per_device_batch=2, seq_len=4096, steps=10)),
+            ("gpt2_moe", dict(per_device_batch=8, seq_len=1024, steps=10)),
         ):
             try:
                 extras.append(run(name, bf16=True, **kw))

@@ -57,19 +57,23 @@ def _fit_block(requested: int, s: int) -> int:
     divisor of s that is a multiple of 8 and <= requested; falls back to the
     full axis (always legal). 512 beat 128/256 on v5e for GPT-2 @ S=1024
     (90.7 vs 143.5 / 109.6 ms per train step), hence the public default."""
-    b = min(requested, s)
+    b = min(max(requested, 8), s)
     if s % b == 0 and (b % 8 == 0 or b == s):
         return b
+    # Degenerate divisors make degenerate grids (S=2056 = 8*257 would run
+    # 8-wide tiles on a 128-wide MXU), so only accept blocks that keep the
+    # grid reasonable: >= 128 wide, or at most 8 blocks along the axis.
+    floor = min(128, max(1, s // 8))
     for cand in range(b - b % 8, 7, -8):
-        if s % cand == 0:
+        if s % cand == 0 and cand >= floor:
             return cand
-    # No multiple-of-8 divisor (s % 8 != 0): spanning the axis is the only
-    # legal block, acceptable for short sequences but it would forfeit the
-    # blockwise VMEM bound for long ones — fail loudly there instead.
+    # No usable divisor: spanning the axis is always legal and fine for
+    # short sequences, but it would forfeit the blockwise VMEM bound for
+    # long ones — fail loudly there instead.
     if s > 1024:
         raise ValueError(
-            f"flash_attention: sequence length {s} has no block size that "
-            f"is a multiple of 8; pad the sequence to a multiple of 8")
+            f"flash_attention: sequence length {s} has no usable block "
+            f"size; pad the sequence to a multiple of 128")
     return s
 
 

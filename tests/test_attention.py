@@ -58,6 +58,12 @@ class TestFlashAttention:
         assert _fit_block(64, 100) == 100
         assert _fit_block(512, 1024) == 512
         assert _fit_block(512, 384) == 384
+        # degenerate divisors (8 | 2056 but grid would be 257 tiny tiles)
+        # and sub-8 requests must not produce pathological kernels
+        with pytest.raises(ValueError, match="block"):
+            _fit_block(512, 2056)
+        assert _fit_block(4, 2048) == 8
+        assert _fit_block(512, 1032) == 344  # >= s//8 floor keeps the grid sane
 
     def test_gradients_match_reference(self):
         q, k, v = _rand_qkv(b=1, s=64, h=2, d=16)
