@@ -78,13 +78,13 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
     mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     kwargs = dict(model_kwargs or {})
-    if "attention_fn" not in kwargs and jax.default_backend() == "tpu":
+    from ..ops.flash_attention import flash_backend_supported
+
+    if "attention_fn" not in kwargs and flash_backend_supported():
         # Benchmark with the flash kernel — the fast path users get via
-        # --attention flash: 42% faster than the einsum path for GPT-2 @
-        # S=1024 on v5e. Legal for BERT too (bidirectional, causal=False):
-        # the benched MLM batches carry no padding mask. Non-TPU backends
-        # stay on the XLA einsum path (CPU would run pallas in interpreter
-        # mode — pure overhead; the pltpu VMEM scratch cannot lower on GPU).
+        # --attention flash (auto default): 42% faster than the einsum path
+        # for GPT-2 @ S=1024 on v5e. Legal for BERT too (bidirectional,
+        # causal=False): the benched MLM batches carry no padding mask.
         from ..ops import make_flash_attention_fn
 
         kwargs["attention_fn"] = make_flash_attention_fn(

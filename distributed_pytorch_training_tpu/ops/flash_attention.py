@@ -49,6 +49,25 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def flash_backend_supported(backend: Optional[str] = None) -> bool:
+    """ONE place for the backend gate shared by the bench harness and
+    ``--attention auto``: the kernels are worth running only on real TPU.
+    CPU would run pallas in interpreter mode (pure overhead); the pltpu
+    VMEM scratch shapes cannot lower on GPU."""
+    return (backend or jax.default_backend()) == "tpu"
+
+
+def flash_supports_length(s: int, requested: int = 512) -> bool:
+    """True iff `_fit_block` can pick a usable block for a length-`s` axis —
+    lets ``--attention auto`` fall back to the einsum path instead of
+    erroring on lengths with no multiple-of-8 divisor (> 1024)."""
+    try:
+        _fit_block(requested, s)
+        return True
+    except ValueError:
+        return False
+
+
 def _fit_block(requested: int, s: int) -> int:
     """Largest legal block size <= `requested` for a length-`s` axis.
 
@@ -56,7 +75,13 @@ def _fit_block(requested: int, s: int) -> int:
     whole axis), and pallas grids need block | s. Prefers the largest
     divisor of s that is a multiple of 8 and <= requested; falls back to the
     full axis (always legal). 512 beat 128/256 on v5e for GPT-2 @ S=1024
-    (90.7 vs 143.5 / 109.6 ms per train step), hence the public default."""
+    (90.7 vs 143.5 / 109.6 ms per train step), hence the public default.
+
+    An explicit request that divides s is honored as-is (clamped up to the
+    legal minimum of 8) — a caller asking for tiny blocks gets tiny blocks
+    (minimal VMEM, their trade); the degenerate-grid floor below only guards
+    the *auto-degradation* path where a large request would silently shrink
+    to slivers."""
     b = min(max(requested, 8), s)
     if s % b == 0 and (b % 8 == 0 or b == s):
         return b

@@ -14,11 +14,21 @@ topology rather than DDP-style dynamic world resizing.
 
 from __future__ import annotations
 
+import os
 import signal
 import threading
 from typing import Optional
 
 from ..utils.logging import log_main
+
+# Hard deadline for the graceful path. "Stop at the next epoch boundary"
+# assumes the process is making progress; a SIGTERM that lands mid-compile
+# (minutes) or while the backend is wedged (forever) must still kill the
+# process — a zombie that swallowed SIGTERM keeps its device claim and
+# blocks every subsequent job from acquiring the chip (observed live on the
+# tunneled v5e: a killed-but-alive trainer wedged the device pool).
+_GRACE_ENV = "DPT_PREEMPT_GRACE_SECONDS"
+_GRACE_DEFAULT = 600.0
 
 
 class PreemptionGuard:
@@ -32,10 +42,15 @@ class PreemptionGuard:
             if guard.should_stop:
                 ckpt.save(epoch + 1, state, wait=True)
                 break
+        guard.disarm()  # graceful path completed; cancel the deadline
 
     Handlers chain to any previously-installed handler; `should_stop` is a
     plain flag so the hot loop pays nothing for it. Signals received twice
-    fall through to the previous handler (second Ctrl-C still kills).
+    fall through to the previous handler (second Ctrl-C still kills). The
+    first signal also arms a hard deadline (``DPT_PREEMPT_GRACE_SECONDS``,
+    default 600): if the process hasn't exited — or called ``disarm()`` —
+    by then, it force-exits with status 143 rather than linger as a
+    device-holding zombie.
     """
 
     _installed: Optional["PreemptionGuard"] = None
@@ -43,6 +58,9 @@ class PreemptionGuard:
     def __init__(self):
         self._stop = threading.Event()
         self._prev = {}
+        self._deadline: Optional[threading.Timer] = None
+        # test seam: replaced to observe the force-exit without dying
+        self._force_exit = lambda: os._exit(143)
 
     @property
     def should_stop(self) -> bool:
@@ -61,13 +79,39 @@ class PreemptionGuard:
                 signal.signal(signum, prev or signal.SIG_DFL)
                 signal.raise_signal(signum)
             return
+        # never raise inside a signal handler: a malformed env value must
+        # not turn SIGTERM into a crash-without-checkpoint
+        try:
+            grace = float(os.environ.get(_GRACE_ENV, _GRACE_DEFAULT))
+        except (TypeError, ValueError):
+            grace = _GRACE_DEFAULT
         log_main(f"Received signal {signum}: will checkpoint and stop at the "
-                 "next epoch boundary")
+                 f"next epoch boundary (hard exit in {grace:.0f}s if the "
+                 "graceful path stalls)")
         self._stop.set()
+        self._arm_deadline(grace)
+
+    def _arm_deadline(self, grace: float) -> None:
+        def expire():
+            log_main(f"Graceful stop did not complete within {grace:.0f}s "
+                     "of the signal; force-exiting (143)")
+            self._force_exit()
+
+        self._deadline = threading.Timer(grace, expire)
+        self._deadline.daemon = True
+        self._deadline.start()
+
+    def disarm(self) -> None:
+        """Cancel the hard-exit deadline — the graceful path completed (or
+        the caller, e.g. a notebook, keeps the process for another run)."""
+        if self._deadline is not None:
+            self._deadline.cancel()
+            self._deadline = None
 
     def reset(self) -> None:
         """Disarm a previously-set stop flag (a new run starts fresh)."""
         self._stop.clear()
+        self.disarm()
 
     @classmethod
     def install(cls, reset: bool = True) -> "PreemptionGuard":

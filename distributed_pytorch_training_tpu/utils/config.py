@@ -65,12 +65,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     parser.add_argument("--seq-len", default=None, type=int,
                         help="sequence length for LM configs (default: 512 "
                              "for bert_base, 1024 for gpt2)")
-    parser.add_argument("--attention", default="xla", type=str,
-                        choices=["xla", "flash", "ring", "ulysses"],
-                        help="attention implementation for causal LM configs: "
+    parser.add_argument("--attention", default="auto", type=str,
+                        choices=["auto", "xla", "flash", "ring", "ulysses"],
+                        help="attention implementation for LM configs: auto "
+                             "(flash on TPU, xla otherwise — the default), "
                              "xla einsum, Pallas flash kernel, ring (KV "
                              "rotation over the mesh seq axis), or ulysses "
-                             "(all-to-all head sharding over seq)")
+                             "(all-to-all head sharding over seq); ring and "
+                             "ulysses are causal-only (gpt2 families)")
     parser.add_argument("--grad-accum", default=1, type=int,
                         help="gradient accumulation: microbatches per "
                              "optimizer step inside the jitted step "

@@ -59,3 +59,22 @@ def test_train_cli_bf16_and_checkpoint_resume(tmp_path):
     train.main(["--epochs", "2", "--resume"] + common)
     lines = (out / "metrics_rank0.csv").read_text().strip().splitlines()
     assert [line.split(",")[0] for line in lines[1:]] == ["1", "2"]
+
+
+def test_attention_auto_resolution():
+    """--attention auto = flash exactly when (LM, TPU backend, no pipeline);
+    explicit choices pass through untouched."""
+    import train as train_mod
+
+    r = train_mod.resolve_attention
+    assert r("auto", True, "tpu", 1) == "flash"
+    assert r("auto", True, "tpu", 2) == "xla"      # pipeline stages: einsum
+    assert r("auto", True, "cpu", 1) == "xla"      # interpreter-mode pallas
+    assert r("auto", True, "gpu", 1) == "xla"      # pltpu scratch won't lower
+    assert r("auto", False, "tpu", 1) == "xla"     # image models
+    # auto never errors where the old default worked: S=2056 has no usable
+    # flash block (raise for explicit flash), so auto stays on xla
+    assert r("auto", True, "tpu", 1, seq_len=2056) == "xla"
+    assert r("auto", True, "tpu", 1, seq_len=4096) == "flash"
+    for explicit in ("xla", "flash", "ring", "ulysses"):
+        assert r(explicit, True, "cpu", 4) == explicit

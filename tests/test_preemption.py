@@ -26,6 +26,32 @@ def test_install_is_idempotent_and_rearms():
     assert not g2.should_stop
 
 
+def test_signal_arms_hard_deadline(monkeypatch):
+    """A SIGTERM that lands while the process is stuck (mid-compile, wedged
+    backend) must still kill it: the first signal arms a hard deadline that
+    force-exits if the graceful path never completes. A swallowed SIGTERM
+    zombie keeps its device claim and wedges the chip for every later job."""
+    monkeypatch.setenv("DPT_PREEMPT_GRACE_SECONDS", "0.2")
+    guard = PreemptionGuard.install()
+    fired = threading.Event()
+    guard._force_exit = fired.set  # observe instead of os._exit(143)
+    os.kill(os.getpid(), signal.SIGTERM)
+    assert guard.should_stop
+    assert fired.wait(timeout=2.0), "hard-exit deadline never fired"
+    guard.reset()
+
+
+def test_disarm_cancels_hard_deadline(monkeypatch):
+    monkeypatch.setenv("DPT_PREEMPT_GRACE_SECONDS", "0.3")
+    guard = PreemptionGuard.install()
+    fired = threading.Event()
+    guard._force_exit = fired.set
+    os.kill(os.getpid(), signal.SIGTERM)
+    guard.disarm()  # graceful path completed promptly
+    assert not fired.wait(timeout=0.8), "deadline fired after disarm"
+    guard.reset()
+
+
 def test_cli_checkpoints_on_preemption(tmp_path, mesh8):
     """Drive main() with SIGTERM arriving mid-run: it must stop early at an
     epoch boundary, write a checkpoint, and a --resume run continues."""
