@@ -137,6 +137,13 @@ def main(argv=None):
 
 def _bench(args):
     t_start = time.time()
+    import os
+
+    if os.environ.get("DPT_BENCH_TEST_HANG"):
+        # test hook (tests/test_bench.py): simulate the observed failure
+        # mode where jax.devices() blocks forever on a wedged tunnel — the
+        # watchdog parent must still emit the error-JSON line
+        time.sleep(10_000)
     try:
         jax, devices = init_backend_with_retry()
     except Exception as e:

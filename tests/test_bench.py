@@ -1,0 +1,43 @@
+"""bench.py contract tests — the driver consumes EXACTLY ONE JSON line from
+stdout; a hung or crashed backend must degrade to an error-JSON, never to
+silence (the round-1 bench lost its round to an unguarded backend hang)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run_bench(extra_args, env_extra=None, timeout=120):
+    env = dict(os.environ)
+    # the subprocess must not inherit the axon TPU platform: the contract
+    # under test is bench's own plumbing, not the accelerator
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")] + extra_args,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=timeout, env=env, cwd=str(REPO))
+    json_lines = [l for l in proc.stdout.decode().splitlines()
+                  if l.startswith("{")]
+    return proc, json_lines
+
+
+def test_watchdog_emits_error_json_when_backend_hangs():
+    """A backend that blocks forever in init (observed live: a wedged
+    tunnel made jax.devices() hang indefinitely) must not eat the round:
+    the watchdog kills the inner process at --deadline and the parent
+    prints the error-JSON line the driver requires."""
+    proc, lines = _run_bench(
+        ["--deadline", "5", "--quick"],
+        env_extra={"DPT_BENCH_TEST_HANG": "1"}, timeout=90)
+    assert proc.returncode != 0
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["value"] == 0.0
+    assert "deadline" in result["error"]
+    assert result["unit"] == "samples/sec/chip"
+    assert set(result) >= {"metric", "value", "unit", "vs_baseline"}
