@@ -131,3 +131,23 @@ def test_remat_bert_and_vit_apply():
                     num_heads=2, mlp_dim=64, patch_size=16, remat=True)
     v = vit.init(jax.random.PRNGKey(0), imgs, train=False)
     assert np.isfinite(np.asarray(vit.apply(v, imgs, train=False))).all()
+
+
+def test_lm_flagship_param_counts():
+    """Pin the flagship LM architectures (BASELINE.json:11-12). GPT-2 sizes
+    are exact matches for the HF reference checkpoints (gpt2: 124,439,808;
+    gpt2-medium: 354,823,168 — tied wte/lm_head like HF); BERT-base pins our
+    own MLM-head construction (~109.5M, within 0.03% of HF bert-base)."""
+    expected = {
+        "gpt2_124m": 124_439_808,
+        "gpt2_355m": 354_823_168,
+        "bert_base": 109_514_298,
+    }
+    for name, want in expected.items():
+        variables = jax.eval_shape(
+            lambda n=name: get_model(n).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                train=False))
+        got = sum(int(np.prod(x.shape))
+                  for x in jax.tree_util.tree_leaves(variables["params"]))
+        assert got == want, f"{name}: {got:,} != {want:,}"
