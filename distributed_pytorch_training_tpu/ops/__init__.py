@@ -6,7 +6,12 @@ schedule optimally: blockwise (flash) attention and the ring-attention
 context-parallel primitive (SURVEY.md §5 long-context requirement).
 """
 
-from .flash_attention import flash_attention, make_flash_attention_fn  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_backend_supported,
+    flash_supports_length,
+    make_flash_attention_fn,
+)
 from .ring_attention import make_ring_attention_fn, ring_attention  # noqa: F401
 from .ulysses_attention import (  # noqa: F401
     make_ulysses_attention_fn,
