@@ -142,7 +142,42 @@ def get_dataset(
         return synthetic_image_dataset(n, (32, 32), 10, seed=seed + (0 if train else 1),
                                        name="cifar10-synthetic")
     if name == "imagenet":
+        if not synthetic:
+            ds = load_imagenet(data_dir, train)
+            if ds is not None:
+                return ds
         n = synthetic_size or _SYNTH_SIZES["imagenet"][0 if train else 1]
         return synthetic_image_dataset(n, (224, 224), 1000, seed=seed + (0 if train else 1),
                                        name="imagenet-synthetic")
     raise ValueError(f"unknown dataset {name!r} (cifar10, imagenet)")
+
+
+def load_imagenet(data_dir: str, train: bool) -> Optional[ArrayDataset]:
+    """Packed-layout ImageNet (or any image corpus): memory-mapped
+    `{split}_images.npy` (N, H, W, 3) uint8 + `{split}_labels.npy` under
+    `{data_dir}/imagenet/`, as written by ``python -m
+    distributed_pytorch_training_tpu.data.pack`` from a class-folder JPEG
+    tree (the torchvision ImageFolder layout the reference-style pipeline
+    reads, ref :103-119 analogue).
+
+    The memmap is the TPU-friendly design: O(1) row access with no JPEG
+    decode in the hot loop — the native prefetcher's row gather pages in
+    exactly the batch rows, so a 150 GB train split needs no resident RAM.
+    Returns None when the packed files are absent (caller falls back to
+    synthetic, loudly)."""
+    import json
+
+    split = "train" if train else "val"
+    base = Path(data_dir) / "imagenet"
+    img_p, lab_p = base / f"{split}_images.npy", base / f"{split}_labels.npy"
+    if not (img_p.exists() and lab_p.exists()):
+        return None
+    images = np.load(img_p, mmap_mode="r")
+    labels = np.load(lab_p)
+    classes_p = base / "classes.json"
+    if classes_p.exists():
+        num_classes = len(json.loads(classes_p.read_text()))
+    else:
+        num_classes = int(labels.max()) + 1
+    return ArrayDataset(images, labels, num_classes=num_classes,
+                        name=f"imagenet-{split}", synthetic=False)

@@ -63,22 +63,25 @@ class ShardedLoader:
     def __len__(self) -> int:
         return self.sampler.steps_per_epoch()
 
-    def _host_batches(self, epoch: int) -> Iterator[Dict[str, np.ndarray]]:
+    def _host_batches(self, epoch: int,
+                      start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         images, labels = self.dataset.images, self.dataset.labels
-        for idx, w in self.sampler.iter_epoch(epoch):
+        for idx, w in self.sampler.iter_epoch(epoch, start_step):
             yield {
                 "image": native.gather_rows(images, idx),
                 "label": labels[idx],
                 "weight": w,
             }
 
-    def _native_epoch(self, epoch: int) -> Optional[Iterator[Dict[str, jax.Array]]]:
+    def _native_epoch(self, epoch: int, start_step: int = 0
+                      ) -> Optional[Iterator[Dict[str, jax.Array]]]:
         """Epoch served by the C++ prefetcher (native/): batch assembly runs
         in native threads off the GIL, `prefetch` buffers deep. Returns None
         when the native library is unavailable (no toolchain / disabled)."""
         if not native.is_available():
             return None
         idx, w = self.sampler.epoch_indices(epoch)
+        idx, w = idx[start_step:], w[start_step:]
 
         def gen():
             pf = native.NativePrefetcher(
@@ -94,15 +97,19 @@ class ShardedLoader:
 
         return gen()
 
-    def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+    def epoch(self, epoch: int,
+              start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
         """Sharded device batches for one epoch. `epoch` seeds the reshuffle
-        (the `set_epoch` contract, ref :184-185)."""
-        it = self._native_epoch(epoch)
+        (the `set_epoch` contract, ref :184-185); `start_step` skips the
+        first batches at the SAMPLER (no wasted assembly) for step-granular
+        preemption resume."""
+        it = self._native_epoch(epoch, start_step)
         if it is not None:
             return it
-        return self._python_epoch(epoch)
+        return self._python_epoch(epoch, start_step)
 
-    def _python_epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
+    def _python_epoch(self, epoch: int,
+                      start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
         """Pure-Python fallback: background thread + queue prefetch."""
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
@@ -111,7 +118,7 @@ class ShardedLoader:
 
         def producer():
             try:
-                for batch in self._host_batches(epoch):
+                for batch in self._host_batches(epoch, start_step):
                     while not stop.is_set():
                         try:
                             q.put(batch, timeout=0.1)

@@ -1,0 +1,128 @@
+"""Pack a class-folder image tree into the framework's on-disk layout.
+
+The torchvision-style ImageFolder tree the reference ecosystem uses
+(`train/<class>/*.JPEG`, ref train_ddp.py:103-119's dataset ancestry) is a
+host-decode-bound format: JPEG decode per sample per epoch, millions of tiny
+files. The TPU-friendly layout is one packed uint8 `.npy` per split —
+memory-mapped at load (datasets.load_imagenet), O(1) row access, batch
+assembly via the native prefetcher's parallel row memcpy, augmentation on
+device. Decode and resize happen ONCE, here, offline:
+
+    python -m distributed_pytorch_training_tpu.data.pack \
+        --src /data/imagenet/train --out ./data/imagenet --split train \
+        --size 224
+
+writes `train_images.npy` (N, 224, 224, 3) uint8, `train_labels.npy`
+(N,) int64, and `classes.json` (sorted class-dir names -> index, the
+torchvision class_to_idx convention). Images are resized so the short side
+is `size` then center-cropped — the standard eval-style geometry; training
+randomness (crop jitter + flip) stays on device (data/augment.py), where it
+is fused into the forward pass.
+
+The writer streams through np.lib.format.open_memmap, so packing a 150 GB
+split needs no resident RAM either.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def _resize_center_crop(img, size: int) -> np.ndarray:
+    """PIL image -> (size, size, 3) uint8: short-side resize + center crop."""
+    w, h = img.size
+    scale = size / min(w, h)
+    img = img.resize((max(size, round(w * scale)),
+                      max(size, round(h * scale))))
+    w, h = img.size
+    left, top = (w - size) // 2, (h - size) // 2
+    img = img.crop((left, top, left + size, top + size))
+    arr = np.asarray(img.convert("RGB"), dtype=np.uint8)
+    return arr
+
+
+def list_class_folders(src: Path) -> List[Tuple[str, List[Path]]]:
+    """[(class_name, [image paths...])] — class dirs sorted by name (the
+    torchvision class_to_idx rule, so indices match an ImageFolder run)."""
+    out = []
+    for cls_dir in sorted(p for p in src.iterdir() if p.is_dir()):
+        files = sorted(p for p in cls_dir.rglob("*")
+                       if p.suffix.lower() in IMAGE_EXTS)
+        if files:
+            out.append((cls_dir.name, files))
+    return out
+
+
+def pack_images(src: str, out: str, split: str, size: int = 224,
+                classes: Optional[Sequence[str]] = None,
+                log=print) -> Tuple[Path, Path]:
+    """Pack `{src}/<class>/*.jpg` into `{out}/{split}_images.npy` +
+    `{split}_labels.npy` (+ classes.json when packing the train split).
+    `classes` pins the class->index map (pass the train split's order when
+    packing val, so label spaces agree even if val misses a class)."""
+    from PIL import Image
+
+    src_p, out_p = Path(src), Path(out)
+    folders = list_class_folders(src_p)
+    if not folders:
+        raise ValueError(f"no class folders with images under {src_p}")
+    if classes is None:
+        classes = [name for name, _ in folders]
+    cls_to_idx = {c: i for i, c in enumerate(classes)}
+    unknown = [name for name, _ in folders if name not in cls_to_idx]
+    if unknown:
+        raise ValueError(f"classes {unknown} not in the provided class map")
+
+    n = sum(len(files) for _, files in folders)
+    out_p.mkdir(parents=True, exist_ok=True)
+    img_path = out_p / f"{split}_images.npy"
+    lab_path = out_p / f"{split}_labels.npy"
+    # stream into a disk-backed memmap: RAM stays O(1) regardless of N
+    images = np.lib.format.open_memmap(
+        img_path, mode="w+", dtype=np.uint8, shape=(n, size, size, 3))
+    labels = np.empty(n, np.int64)
+    i = 0
+    for name, files in folders:
+        for f in files:
+            with Image.open(f) as im:
+                images[i] = _resize_center_crop(im, size)
+            labels[i] = cls_to_idx[name]
+            i += 1
+        log(f"pack: {split}: {name} done ({i}/{n})")
+    images.flush()
+    np.save(lab_path, labels)
+    if classes is not None:
+        (out_p / "classes.json").write_text(json.dumps(list(classes)))
+    log(f"pack: wrote {img_path} {images.shape} + {lab_path}")
+    return img_path, lab_path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--src", required=True,
+                   help="class-folder tree (ImageFolder layout)")
+    p.add_argument("--out", required=True,
+                   help="output dir (becomes --data-dir/imagenet)")
+    p.add_argument("--split", default="train", choices=["train", "val"])
+    p.add_argument("--size", default=224, type=int)
+    p.add_argument("--classes-from", default=None,
+                   help="classes.json from a previous (train) pack, to pin "
+                        "the class->index map for the val split")
+    args = p.parse_args(argv)
+    classes = None
+    if args.classes_from:
+        classes = json.loads(Path(args.classes_from).read_text())
+    pack_images(args.src, args.out, args.split, args.size, classes)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,11 @@ class ShardedSampler:
         weights = weights.reshape(steps, self.process_count, self.local_batch)
         return order[:, self.process_index], weights[:, self.process_index]
 
-    def iter_epoch(self, epoch: int) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def iter_epoch(self, epoch: int,
+                   start_step: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """`start_step` resumes mid-epoch: the permutation is deterministic
+        in (seed, epoch), so skipping the first batches reproduces the
+        uninterrupted trajectory exactly (step-granular preemption resume)."""
         idx, w = self.epoch_indices(epoch)
-        for step in range(idx.shape[0]):
+        for step in range(start_step, idx.shape[0]):
             yield idx[step], w[step]

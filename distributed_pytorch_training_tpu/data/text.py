@@ -62,11 +62,17 @@ def synthetic_token_dataset(
 
 
 def load_token_file(path: str, seq_len: int, vocab_size: int) -> TokenDataset:
-    """Load a packed token file (.npy or flat binary of uint16/uint32) and
-    chunk into (N, seq_len)."""
+    """Load a packed token file (.npy, or flat .bin of uint16 — the
+    nanoGPT-style layout) and chunk into (N, seq_len). Written by
+    ``python -m distributed_pytorch_training_tpu.data.tokenize`` (GPT-2 BPE
+    via transformers, or the dependency-free byte-level fallback).
+
+    .npy loads memory-mapped; the int32 conversion below materializes the
+    (truncated) token matrix — at GPT-2 scales (billions of tokens) swap
+    the model input pipeline to uint16 gathers before worrying here."""
     p = Path(path)
     if p.suffix == ".npy":
-        flat = np.load(p).ravel()
+        flat = np.load(p, mmap_mode="r").ravel()
     else:
         flat = np.fromfile(p, dtype=np.uint16).astype(np.int64)
     n = len(flat) // seq_len
@@ -120,8 +126,9 @@ class TokenLoader:
     def __len__(self) -> int:
         return self.sampler.steps_per_epoch()
 
-    def epoch(self, epoch: int) -> Iterator[Dict[str, jax.Array]]:
-        for idx, w in self.sampler.iter_epoch(epoch):
+    def epoch(self, epoch: int,
+              start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
+        for idx, w in self.sampler.iter_epoch(epoch, start_step):
             yield shard_batch({
                 # native byte-wise row gather (works for int32 rows too)
                 "input_ids": native.gather_rows(self.dataset.tokens, idx),

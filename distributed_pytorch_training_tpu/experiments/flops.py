@@ -124,9 +124,17 @@ def _jaxpr_flops(jaxpr) -> float:
             # trip count unknown statically; count one iteration (lower bound)
             total += _jaxpr_flops(eqn.params["body_jaxpr"].jaxpr)
         elif name == "pallas_call":
-            # the sub-jaxpr is ONE grid tile's kernel body: scale by the
-            # grid size or the kernel's matmuls vanish from the count
-            # (a flash-attention model would understate MFU by Sq*Sk/blk^2)
+            # Prefer the kernel author's exact CostEstimate: our flash
+            # kernels pass causal-aware counts (live diagonal blocks only).
+            # Fallback — scale ONE tile's kernel body by the grid size, or
+            # the kernel's matmuls vanish from the count; this overcounts
+            # causal kernels ~2x (pl.when-skipped blocks), which is why the
+            # estimate channel exists.
+            ce = eqn.params.get("cost_estimate")
+            ce_flops = getattr(ce, "flops", None) if ce is not None else None
+            if ce_flops:
+                total += float(ce_flops)
+                continue
             grid = ()
             gm = eqn.params.get("grid_mapping")
             if gm is not None:

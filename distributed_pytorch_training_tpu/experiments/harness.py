@@ -78,13 +78,18 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
     mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     kwargs = dict(model_kwargs or {})
-    from ..ops.flash_attention import flash_backend_supported
+    from ..ops.flash_attention import (
+        flash_backend_supported, flash_supports_length,
+    )
 
-    if "attention_fn" not in kwargs and flash_backend_supported():
+    if "attention_fn" not in kwargs and flash_backend_supported() \
+            and flash_supports_length(seq_len):
         # Benchmark with the flash kernel — the fast path users get via
         # --attention flash (auto default): 42% faster than the einsum path
         # for GPT-2 @ S=1024 on v5e. Legal for BERT too (bidirectional,
-        # causal=False): the benched MLM batches carry no padding mask.
+        # causal=False; padding masks ride the kernel). The length gate
+        # matches resolve_attention: a seq_len with no usable block (e.g.
+        # 2056) falls back to the einsum path instead of erroring at trace.
         from ..ops import make_flash_attention_fn
 
         kwargs["attention_fn"] = make_flash_attention_fn(

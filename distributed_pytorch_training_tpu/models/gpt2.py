@@ -55,14 +55,14 @@ class GPT2LMHead(nn.Module):
                          embedding_init=nn.initializers.normal(stddev=0.01),
                          name="wpe")(pos_ids)
 
-        # Kernel attention paths (flash/ring) own the causal structure and
-        # reject explicit masks; the XLA einsum path takes a mask array.
+        # Kernel attention paths (flash/ring) own the causal structure, so
+        # they get ONLY the padding mask (flash applies it inside the
+        # blocks; ring/ulysses raise — their adapters need the XLA path).
+        # The XLA einsum path takes the combined causal & padding mask.
         uses_kernel = self.attention_fn is not dot_product_attention
         if uses_kernel:
-            if attention_mask is not None:
-                raise ValueError("flash/ring attention paths do not support "
-                                 "padding masks; use the XLA attention path")
-            mask = None
+            mask = (attention_mask[:, None, None, :].astype(bool)
+                    if attention_mask is not None else None)
         else:
             mask = causal_mask(s)
             if attention_mask is not None:

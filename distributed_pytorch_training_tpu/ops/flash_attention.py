@@ -77,11 +77,12 @@ def _fit_block(requested: int, s: int) -> int:
     full axis (always legal). 512 beat 128/256 on v5e for GPT-2 @ S=1024
     (90.7 vs 143.5 / 109.6 ms per train step), hence the public default.
 
-    An explicit request that divides s is honored as-is (clamped up to the
-    legal minimum of 8) — a caller asking for tiny blocks gets tiny blocks
-    (minimal VMEM, their trade); the degenerate-grid floor below only guards
-    the *auto-degradation* path where a large request would silently shrink
-    to slivers."""
+    An explicit multiple-of-8 request that divides s is honored as-is (the
+    %8 requirement is the TPU sublane rule; e.g. requested=100 with s=200
+    divides evenly but still goes through the search) — a caller asking for
+    small legal blocks gets them (minimal VMEM, their trade); the
+    degenerate-grid floor below only guards the *auto-degradation* path
+    where a large request would silently shrink to slivers."""
     b = min(max(requested, 8), s)
     if s % b == 0 and (b % 8 == 0 or b == s):
         return b
@@ -102,15 +103,39 @@ def _fit_block(requested: int, s: int) -> int:
     return s
 
 
-def _reference_attention(q, k, v, causal: bool, sm_scale: float):
+def _reference_attention(q, k, v, causal: bool, sm_scale: float,
+                         kv_valid=None):
     """XLA einsum attention — the parity oracle for tests."""
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * sm_scale
     if causal:
         s_q, s_k = q.shape[1], k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), bool))[None, None]
         logits = jnp.where(mask, logits, NEG_INF)
+    if kv_valid is not None:
+        logits = jnp.where(kv_valid[:, None, None, :] > 0, logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bthd->bshd", weights, v)
+
+
+def _live_pairs(nqb: int, nkb: int, block_q: int, block_k: int,
+                causal: bool) -> int:
+    """Number of (q-block, k-block) grid pairs that issue MXU work — causal
+    skips blocks fully above the diagonal, so FLOPs accounting that scales
+    one tile by the whole grid would overcount attention ~2x."""
+    if not causal:
+        return nqb * nkb
+    qb = np.arange(nqb)[:, None] * block_q + block_q - 1
+    kb = np.arange(nkb)[None, :] * block_k
+    return int(np.sum(qb >= kb))
+
+
+def _cost(flops: float, transcendentals: float, bytes_accessed: float):
+    """Exact per-call cost handed to pallas_call so FLOPs instruments (XLA's
+    and experiments/flops.py's jaxpr walk) see the causal-aware count
+    instead of scaling one tile's matmuls by the full rectangular grid."""
+    return pl.CostEstimate(flops=int(flops),
+                           transcendentals=int(transcendentals),
+                           bytes_accessed=int(bytes_accessed))
 
 
 # ---------------------------------------------------------------------------
@@ -118,8 +143,13 @@ def _reference_attention(q, k, v, causal: bool, sm_scale: float):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, block_q: int, block_k: int, causal: bool, sm_scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs,
+                block_q: int, block_k: int, causal: bool, sm_scale: float,
+                masked: bool):
+    if masked:
+        kvm_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        kvm_ref, (o_ref, lse_ref, m_scr, l_scr, acc_scr) = None, refs
     qb, kb = pl.program_id(1), pl.program_id(2)
     nkb = pl.num_programs(2)
 
@@ -144,6 +174,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            # key-padding: masked keys contribute nothing to any query row.
+            # Safe online-softmax interaction: an all-masked block leaves m
+            # at NEG_INF, so p==1 garbage can accumulate only until the
+            # first live block, whose alpha rescales it to exactly 0.
+            s = jnp.where(kvm_ref[0, 0][None, :] > 0, s, NEG_INF)
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -161,8 +197,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
-                   block_q: int, block_k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (out (BH, Sq, d) folded back to (B, Sq, H, d), lse (BH, 1, Sq))."""
+                   block_q: int, block_k: int,
+                   kv_valid=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (out (BH, Sq, d) folded back to (B, Sq, H, d), lse (BH, 1, Sq)).
+    `kv_valid`: optional (B, Sk) float validity mask (1=real key, 0=pad)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
@@ -171,25 +209,35 @@ def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
 
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
+    masked = kv_valid is not None
 
     grid = (b * h, sq // block_q, sk // block_k)
+    live = _live_pairs(sq // block_q, sk // block_k, block_q, block_k, causal)
     # lse rides as (BH, 1, Sq): a 2-D (BH, Sq) output with block (1, block_q)
     # violates the TPU lowering rule that the second-to-last block dim be
     # divisible by 8 or span the array dim; the singleton middle axis spans
     # its dim, making the (1, 1, block_q) block legal on hardware.
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+    ]
+    operands = [qf, kf, vf]
+    if masked:
+        # (B, 1, Sk) so the (1, 1, block_k) block lowers like lse does; the
+        # index map folds heads back to the batch row — no BH-sized copy.
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, i, j, h=h: (bh // h, 0, j)))
+        operands.append(kv_valid.astype(jnp.float32)[:, None, :])
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
-                          causal=causal, sm_scale=sm_scale),
+                          causal=causal, sm_scale=sm_scale, masked=masked),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
@@ -199,8 +247,17 @@ def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        cost_estimate=_cost(
+            # per live pair per bh: QK^T + PV, 2*2*bq*bk*d
+            flops=b * h * live * 4 * block_q * block_k * d,
+            # exp(s - m_new) per live tile + the finalize log per q row
+            transcendentals=b * h * (live * block_q * block_k + sq),
+            bytes_accessed=(
+                b * h * grid[1] * grid[2] *
+                (block_q * d + 2 * block_k * d) * q.dtype.itemsize
+                + b * h * sq * (d * q.dtype.itemsize + 4))),
         interpret=_interpret(),
-    )(qf, kf, vf)
+    )(*operands)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
 
@@ -209,10 +266,13 @@ def _flash_fwd_lse(q, k, v, causal: bool, sm_scale: float,
 # ---------------------------------------------------------------------------
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, block_q: int, block_k: int, causal: bool,
-                    sm_scale: float):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                    block_q: int, block_k: int, causal: bool,
+                    sm_scale: float, masked: bool):
+    if masked:
+        kvm_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        kvm_ref, (dk_ref, dv_ref, dk_scr, dv_scr) = None, refs
     kb, qb = pl.program_id(1), pl.program_id(2)
     nqb = pl.num_programs(2)
 
@@ -238,6 +298,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            # re-mask in the backward: without it p=exp(s-lse) would be
+            # nonzero at padded keys and leak gradient into padding K/V
+            s = jnp.where(kvm_ref[0, 0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                              # (bq, bk)
         dv_scr[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
@@ -250,10 +314,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr,
-                   *, block_q: int, block_k: int, causal: bool,
-                   sm_scale: float):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *refs,
+                   block_q: int, block_k: int, causal: bool,
+                   sm_scale: float, masked: bool):
+    if masked:
+        kvm_ref, dq_ref, dq_scr = refs
+    else:
+        kvm_ref, (dq_ref, dq_scr) = None, refs
     qb, kb = pl.program_id(1), pl.program_id(2)
     nkb = pl.num_programs(2)
 
@@ -278,6 +345,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if masked:
+            s = jnp.where(kvm_ref[0, 0][None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
@@ -289,11 +358,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, kv_valid=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
+    masked = kv_valid is not None
 
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
@@ -304,25 +374,38 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
     # (BH, 1, Sq) like lse so its (1, 1, block_q) block lowers on TPU.
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)[:, None, :]
+    kvm = kv_valid.astype(jnp.float32)[:, None, :] if masked else None
+
+    nqb, nkb = sq // block_q, sk // block_k
+    live = _live_pairs(nqb, nkb, block_q, block_k, causal)
+    read_bytes = (b * h * nqb * nkb *
+                  (2 * block_q * d + 2 * block_k * d) * q.dtype.itemsize)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, j, 0))
     row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, j))
+    dkv_in_specs = [
+        q_spec,                                               # q by j
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
+        q_spec,                                               # dO by j
+        row_spec,                                             # lse by j
+        row_spec,                                             # delta by j
+    ]
+    dkv_operands = [qf, kf, vf, dof, lse, delta]
+    if masked:
+        # the K-block index is i in this kernel's grid
+        dkv_in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, i, j, h=h: (bh // h, 0, i)))
+        dkv_operands.append(kvm)
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
-                          causal=causal, sm_scale=sm_scale),
+                          causal=causal, sm_scale=sm_scale, masked=masked),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
         ],
-        grid=(b * h, sk // block_k, sq // block_q),
-        in_specs=[
-            q_spec,                                               # q by j
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
-            q_spec,                                               # dO by j
-            row_spec,                                             # lse by j
-            row_spec,                                             # delta by j
-        ],
+        grid=(b * h, nkb, nqb),
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, i, 0)),
@@ -331,27 +414,45 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        cost_estimate=_cost(
+            # per live pair: s, dv+=p^T dO, dp=dO v^T, dk+=ds^T q
+            flops=b * h * live * 8 * block_q * block_k * d,
+            transcendentals=b * h * live * block_q * block_k,
+            bytes_accessed=read_bytes +
+            b * h * 2 * sk * d * k.dtype.itemsize),
         interpret=_interpret(),
     )
-    dk, dv = dkv(qf, kf, vf, dof, lse, delta)
+    dk, dv = dkv(*dkv_operands)
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
+    ]
+    dq_operands = [qf, kf, vf, dof, lse, delta]
+    if masked:
+        dq_in_specs.append(pl.BlockSpec(
+            (1, 1, block_k), lambda bh, i, j, h=h: (bh // h, 0, j)))
+        dq_operands.append(kvm)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                          causal=causal, sm_scale=sm_scale),
+                          causal=causal, sm_scale=sm_scale, masked=masked),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        grid=(b * h, sq // block_q, sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
-        ],
+        grid=(b * h, nqb, nkb),
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        cost_estimate=_cost(
+            # per live pair: s, dp=dO v^T, dq+=ds k
+            flops=b * h * live * 6 * block_q * block_k * d,
+            transcendentals=b * h * live * block_q * block_k,
+            bytes_accessed=read_bytes +
+            b * h * sq * d * q.dtype.itemsize),
         interpret=_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(*dq_operands)
 
     def unflat(x, s):
         return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
@@ -373,42 +474,79 @@ def flash_attention(
     sm_scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 512,
+    kv_valid: Optional[jnp.ndarray] = None,  # (B, Sk), 1=real key, 0=pad
 ) -> jnp.ndarray:
-    """Blockwise attention; numerically equivalent to softmax(QK^T*scale)V."""
+    """Blockwise attention; numerically equivalent to softmax(QK^T*scale)V.
+
+    `kv_valid` is a key-padding validity mask applied inside the blocks
+    (forward AND backward recompute), so padded batches keep the flash fast
+    path. Rows whose keys are ALL masked emit mean(V) — the standard
+    contract that the loss zero-weights padded query rows (then their
+    cotangent is exactly 0 and no gradient leaks through the garbage)."""
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out, _ = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k)
+    out, _ = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k,
+                            kv_valid)
     return out
 
 
-def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, kv_valid=None):
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    out, lse = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_fwd_lse(q, k, v, causal, scale, block_q, block_k,
+                              kv_valid)
+    return out, (q, k, v, out, lse, kv_valid)
 
 
 def _vjp_bwd(causal, sm_scale, block_q, block_k, residuals, g):
-    q, k, v, out, lse = residuals
+    q, k, v, out, lse, kv_valid = residuals
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                            block_k, kv_valid)
+    dmask = None if kv_valid is None else jnp.zeros_like(kv_valid)
+    return dq, dk, dv, dmask
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 
 
+def _as_kv_valid(mask, batch: int, sk: int) -> Optional[jnp.ndarray]:
+    """Extract a (B, Sk) key-validity vector from a models.layers-style
+    attention mask (broadcastable to (B, H, Sq, Sk), True=attend), or None
+    when the mask is not a pure key-padding pattern."""
+    if mask is None:
+        return None
+    shape = tuple(mask.shape)
+    # the padding_mask() form: (B, 1, 1, Sk) — constant over heads and rows
+    if len(shape) == 4 and shape[0] in (1, batch) and shape[1] == 1 \
+            and shape[2] == 1 and shape[3] == sk:
+        kv = mask[:, 0, 0, :]
+        return jnp.broadcast_to(kv, (batch, sk))
+    if len(shape) == 2 and shape == (batch, sk):
+        return mask
+    return None
+
+
 def make_flash_attention_fn(causal: bool, block_q: int = 512, block_k: int = 512):
     """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`.
 
-    The mask argument must be None (padding masks need the XLA path); causal
-    structure is handled inside the kernel via block skipping, which is why
-    this is faster than passing a causal mask to the einsum path.
-    """
+    Causal structure is handled inside the kernel via block skipping (faster
+    than passing a causal mask to the einsum path). Key-padding masks — the
+    (B, 1, 1, Sk) form layers.padding_mask produces — ride the kernel too,
+    so real padded batches (BERT MLM) keep the flash path. Any other mask
+    shape falls back to the XLA einsum path rather than erroring: the fast
+    path must cover all data, and general (Sq, Sk)-structured masks have no
+    blockwise formulation here."""
 
     def attention_fn(q, k, v, mask=None, dtype=jnp.float32):
-        if mask is not None:
-            raise ValueError(
-                "flash attention path handles causal masking internally; "
-                "explicit masks require the XLA attention path")
-        return flash_attention(q, k, v, causal, None, block_q, block_k
-                               ).astype(dtype)
+        kv_valid = _as_kv_valid(mask, q.shape[0], k.shape[1])
+        if mask is not None and kv_valid is None:
+            from ..models.layers import dot_product_attention
+
+            if causal:
+                cm = jnp.tril(jnp.ones((q.shape[1], k.shape[1]),
+                                       bool))[None, None]
+                mask = mask.astype(bool) & cm
+            return dot_product_attention(q, k, v, mask=mask, dtype=dtype)
+        return flash_attention(q, k, v, causal, None, block_q, block_k,
+                               kv_valid).astype(dtype)
 
     return attention_fn

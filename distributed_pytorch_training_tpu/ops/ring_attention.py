@@ -17,11 +17,23 @@ Implemented with ``lax.scan`` (reverse-differentiable; ``ppermute`` has a
 transpose rule, so gradients also ride the ring — no custom VJP needed) and
 wrapped in ``shard_map`` so it composes inside a jitted train step.
 
-Memory note: the cross-DEVICE memory is the O(S/n) ring win; within a ring
-step the local score block is computed in Q row chunks under
-``jax.checkpoint`` (``q_chunk``, default 512), bounding live memory to
-O(q_chunk x S/n) in forward and backward instead of the full (S/n, S/n)
-block. A fused ring+Pallas inner block is a further optimization;
+Memory note: the cross-DEVICE memory is the O(S/n) ring win. The inner block
+has two formulations, picked by ``use_pallas`` (auto: the flash kernel on
+TPU when the shard length has a usable block size):
+
+* **fused ring+flash** (the fast path): each ring step runs the blockwise
+  Pallas forward kernel on the local (Q, K_j, V_j) block and merges the
+  normalized partials with the fp32 log-sum-exp rule; the backward re-runs
+  the ring calling the flash dq/dkv kernels against the GLOBAL lse (the
+  p = exp(s - lse_final) identity makes per-block grads exact), with dk/dv
+  accumulators rotating alongside K/V so they arrive home after n hops.
+  Causal rings skip future blocks entirely (lax.cond, ~2x at scale); the
+  diagonal block runs the causal kernel, past blocks the full kernel.
+* **einsum + q-chunking** (the fallback): the local score block is computed
+  in Q row chunks under ``jax.checkpoint`` (``q_chunk``, default 512),
+  bounding live memory to O(q_chunk x S/n) instead of the full (S/n, S/n)
+  block.
+
 `ops.ulysses_attention` offers the alternative all-to-all layout that runs
 the Pallas kernel on full sequences.
 """
@@ -138,6 +150,132 @@ def _ring_body(q, k, v, axis_name: str, causal: bool, sm_scale: float,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B, S, H, D)
 
 
+# ---------------------------------------------------------------------------
+# fused ring + flash inner block
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name: str, causal: bool, sm_scale: float,
+                block_q: int, block_k: int):
+    """Per-device fused ring body (inside shard_map): the Pallas flash
+    forward on each ring step's local block, fp32 lse-merge across steps.
+    Differentiable via an explicit ring backward (below) — the flash
+    kernels' own grads against the global lse, not autodiff through the
+    scan's einsum."""
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                  block_q, block_k)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                         block_q, block_k):
+    from .flash_attention import _flash_fwd_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def fwd_block(k_cur, v_cur, blk_causal):
+        o_j, lse_j = _flash_fwd_lse(q, k_cur, v_cur, blk_causal, sm_scale,
+                                    block_q, block_k)
+        return o_j.astype(jnp.float32), lse_j
+
+    def step(carry, t):
+        k_cur, v_cur, o, lse = carry
+        j = (my - t) % n  # which global shard this K/V block is
+        if causal:
+            # diagonal -> causal kernel; past -> full kernel; future ->
+            # skipped entirely (the ~2x causal win the einsum ring only
+            # gets as masked-but-computed blocks)
+            o_j, lse_j = lax.cond(
+                j == my,
+                lambda: fwd_block(k_cur, v_cur, True),
+                lambda: lax.cond(
+                    j < my,
+                    lambda: fwd_block(k_cur, v_cur, False),
+                    lambda: (jnp.zeros((b, s_loc, h, d), jnp.float32),
+                             jnp.full((b * h, 1, s_loc), NEG_INF,
+                                      jnp.float32))))
+        else:
+            o_j, lse_j = fwd_block(k_cur, v_cur, False)
+        # merge normalized partials: o = sum_j exp(lse_j - lse) o_j
+        lse_new = jnp.logaddexp(lse, lse_j)
+
+        def rw(wx):  # (BH, 1, S) weight -> (B, S, H, 1)
+            return wx.reshape(b, h, s_loc).transpose(0, 2, 1)[..., None]
+
+        o = o * rw(jnp.exp(lse - lse_new)) + o_j * rw(jnp.exp(lse_j - lse_new))
+        return (lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm), o, lse_new), None
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b * h, 1, s_loc), NEG_INF, jnp.float32)
+    (_, _, o, lse), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, sm_scale,
+                        block_q, block_k):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
+                                    block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k,
+                        residuals, g):
+    """Ring backward: rotate K/V around again, run the flash dq/dkv kernels
+    per block against the GLOBAL lse (p = exp(s - lse_final) gives exact
+    per-block partials), and rotate the dk/dv accumulators alongside so
+    each shard's gradients arrive back at their owner after n hops."""
+    from .flash_attention import _flash_bwd
+
+    q, k, v, out, lse = residuals
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def bwd_block(k_cur, v_cur, blk_causal):
+        dq_j, dk_j, dv_j = _flash_bwd(q, k_cur, v_cur, out, lse, g,
+                                      blk_causal, sm_scale, block_q, block_k)
+        return (dq_j.astype(jnp.float32), dk_j.astype(jnp.float32),
+                dv_j.astype(jnp.float32))
+
+    def zeros3():
+        z = jnp.zeros((b, s_loc, h, d), jnp.float32)
+        return z, z, z
+
+    def step(carry, t):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        j = (my - t) % n
+        if causal:
+            dq_j, dk_j, dv_j = lax.cond(
+                j == my,
+                lambda: bwd_block(k_cur, v_cur, True),
+                lambda: lax.cond(
+                    j < my,
+                    lambda: bwd_block(k_cur, v_cur, False),
+                    zeros3))
+        else:
+            dq_j, dk_j, dv_j = bwd_block(k_cur, v_cur, False)
+        return (lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm),
+                lax.ppermute(dk_cur + dk_j, axis_name, perm),
+                lax.ppermute(dv_cur + dv_j, axis_name, perm),
+                dq + dq_j), None
+
+    z = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    (_, _, dk_acc, dv_acc, dq_acc), _ = lax.scan(
+        step, (k, v, z, z, z), jnp.arange(n))
+    return (dq_acc.astype(q.dtype), dk_acc.astype(k.dtype),
+            dv_acc.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def ring_attention(
     q: jnp.ndarray,  # (B, S, H, D) — S sharded over `axis_name`
     k: jnp.ndarray,
@@ -147,29 +285,53 @@ def ring_attention(
     sm_scale: Optional[float] = None,
     axis_name: str = SEQ,
     q_chunk: int = 512,
+    use_pallas: Optional[bool] = None,
+    block_q: int = 512,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Sequence-parallel attention over the mesh `seq` axis.
 
     Composes inside jit: shard_map forces the (B, S, H, D) operands onto
     (batch-axes, seq, model, -) layout; XLA reshards neighbors as needed.
     With seq axis size 1 this degrades to ordinary attention semantics.
-    `q_chunk` bounds per-ring-step score memory (see `_ring_body`).
-    """
+
+    ``use_pallas`` picks the inner block: None (default) auto-selects the
+    fused ring+flash path on TPU when the SHARD length (S / seq-axis) has a
+    usable block size, else the q-chunked einsum (``q_chunk`` bounds its
+    per-ring-step score memory, see `_ring_body`). Tests force either path
+    explicitly (the flash kernels run in interpreter mode on CPU)."""
+    from .flash_attention import flash_backend_supported, flash_supports_length
+
     scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    n_seq = dict(mesh.shape).get(axis_name, 1)
+    s_loc = q.shape[1] // max(n_seq, 1)
+    if use_pallas is None:
+        use_pallas = (flash_backend_supported()
+                      and flash_supports_length(s_loc, block_q)
+                      and flash_supports_length(s_loc, block_k))
     spec = P(BATCH_AXES, axis_name, MODEL, None)
-    body = functools.partial(_ring_body, axis_name=axis_name, causal=causal,
-                             sm_scale=scale, q_chunk=q_chunk)
+    if use_pallas:
+        # positional call: custom_vjp nondiff_argnums are positional
+        def body(q, k, v):
+            return _ring_flash(q, k, v, axis_name, causal, scale,
+                               block_q, block_k)
+    else:
+        body = functools.partial(_ring_body, axis_name=axis_name,
+                                 causal=causal, sm_scale=scale,
+                                 q_chunk=q_chunk)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
 def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ,
-                           q_chunk: int = 512):
+                           q_chunk: int = 512,
+                           use_pallas: Optional[bool] = None):
     """Adapter matching models.layers' `attention_fn(q, k, v, mask, dtype)`.
 
-    As with the flash path, explicit masks are unsupported — causal structure
-    is positional, computed from global offsets on each shard. `q_chunk`
-    bounds per-ring-step score memory (forwarded to `ring_attention`).
+    Explicit masks are unsupported — causal structure is positional,
+    computed from global offsets on each shard. `q_chunk` bounds the
+    einsum fallback's per-ring-step score memory; `use_pallas` forwards
+    the inner-block choice (None = auto: flash on TPU).
     """
 
     def attention_fn(q, k, v, mask=None, dtype=jnp.float32):
@@ -178,7 +340,7 @@ def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ,
                 "ring attention handles causal masking internally; explicit "
                 "masks require the XLA attention path")
         return ring_attention(q, k, v, mesh, causal=causal,
-                              axis_name=axis_name,
-                              q_chunk=q_chunk).astype(dtype)
+                              axis_name=axis_name, q_chunk=q_chunk,
+                              use_pallas=use_pallas).astype(dtype)
 
     return attention_fn

@@ -14,23 +14,35 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Optional, Tuple
 
+import numpy as np
 import orbax.checkpoint as ocp
 
 from .train_state import TrainState
 
 
-def _arrays(state: TrainState) -> dict:
+def _arrays(state: TrainState, epoch: int = 0, step_in_epoch: int = 0) -> dict:
     return {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
+        # step-granular resume coordinates: the sampler is deterministic in
+        # (seed, epoch), so (epoch, step_in_epoch) fully locates the
+        # trajectory — a preemption at minute 50 no longer replays the
+        # epoch. 0-d ndarrays, NOT numpy scalars: orbax's restore-template
+        # validation rejects np.int32(0) (not in its supported leaf types).
+        "epoch": np.asarray(epoch, np.int32),
+        "step_in_epoch": np.asarray(step_in_epoch, np.int32),
     }
 
 
 class CheckpointManager:
-    """Epoch-granular save/restore-latest (the resume story the reference's
-    append-only CSV hints at but never implements, ref :349-354)."""
+    """Step-granular save/restore-latest (the resume story the reference's
+    append-only CSV hints at but never implements, ref :349-354).
+
+    `label` orders checkpoints (use epoch * steps_per_epoch + step so
+    mid-epoch preemption saves sort between epoch boundaries); the restored
+    (epoch, step_in_epoch) pair tells the caller exactly where to resume."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self._mgr = ocp.CheckpointManager(
@@ -39,26 +51,34 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True),
         )
 
-    def save(self, epoch: int, state: TrainState, wait: bool = False) -> None:
-        self._mgr.save(epoch, args=ocp.args.StandardSave(_arrays(state)))
+    def save(self, label: int, state: TrainState, wait: bool = False,
+             epoch: Optional[int] = None, step_in_epoch: int = 0) -> None:
+        """`epoch` defaults to `label` (the legacy epoch-granular callers
+        label saves by completed-epoch count)."""
+        self._mgr.save(label, args=ocp.args.StandardSave(
+            _arrays(state, label if epoch is None else epoch, step_in_epoch)))
         if wait:
             self._mgr.wait_until_finished()
 
-    def restore_latest(self, template: TrainState) -> Optional[Tuple[TrainState, int]]:
-        """Returns (state, epoch) or None if no checkpoint exists. `template`
-        supplies structure/sharding for every restored array."""
-        step = self._mgr.latest_step()
-        if step is None:
+    def restore_latest(
+        self, template: TrainState,
+    ) -> Optional[Tuple[TrainState, int, int]]:
+        """Returns (state, epoch, step_in_epoch) or None if no checkpoint
+        exists. `template` supplies structure/sharding for every restored
+        array. step_in_epoch > 0 means the save was a mid-epoch preemption:
+        resume epoch `epoch` AT that step (the loaders' start_step)."""
+        label = self._mgr.latest_step()
+        if label is None:
             return None
         restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(_arrays(template)))
+            label, args=ocp.args.StandardRestore(_arrays(template)))
         state = template.replace(
             step=restored["step"],
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
         )
-        return state, step
+        return state, int(restored["epoch"]), int(restored["step_in_epoch"])
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

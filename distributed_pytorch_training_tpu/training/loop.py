@@ -209,22 +209,31 @@ class Trainer:
         steps_per_epoch: int,
         samples_per_step: Optional[Sequence[int]] = None,
         step_hook: Optional[Any] = None,
-    ) -> Tuple[TrainState, float, float, float]:
+        start_step: int = 0,
+        stop_fn: Optional[Any] = None,
+    ) -> Tuple[TrainState, float, float, float, int]:
         """One epoch (maps train_one_epoch, ref :170-263). Returns
-        (state, global mean loss, global top-1 %, epoch wall seconds).
-        `step_hook(step_index)` fires before each step (profiler windows)."""
+        (state, global mean loss, global top-1 %, epoch wall seconds,
+        steps executed). `step_hook(step_index)` fires before each step
+        (profiler windows). `start_step` labels a mid-epoch resume (the
+        caller hands an already-offset batch iterator; the per-step RNG is
+        folded from state.step, so the restored trajectory is identical).
+        `stop_fn()` checked after every step: True breaks the loop — the
+        step-granular preemption point (steps executed < full epoch)."""
         cfg = self.config
         epoch_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), epoch)
 
         epoch_metrics = zero_metrics()
         t_epoch = time.time()
         meter = ThroughputMeter()
+        steps_done = 0
 
         for i, batch in enumerate(batches):
             if step_hook is not None:
                 step_hook(i)
             state, metrics = self._train_step(state, batch, epoch_key)
             epoch_metrics = add_metrics(epoch_metrics, metrics)
+            steps_done = i + 1
             # sample count is host-known (sampler math), no device fetch:
             if samples_per_step is not None:
                 meter.update(samples_per_step[min(i, len(samples_per_step) - 1)])
@@ -242,12 +251,16 @@ class Trainer:
                                / self._peak_flops_total)
                     mfu = f"  MFU: {mfu_pct:.1f}%"
                 log_main(
-                    f"Epoch [{epoch + 1}] Step [{i + 1}/{steps_per_epoch}] "
+                    f"Epoch [{epoch + 1}] "
+                    f"Step [{start_step + i + 1}/{steps_per_epoch}] "
                     f"Loss: {avg_loss:.4f}  "
                     f"Acc: {avg_acc:.2f}%  "
                     f"Throughput: {rate:.2f} samples/s (global)" + mfu
                 )
                 meter.reset()
+
+            if stop_fn is not None and stop_fn():
+                break
 
         # Epoch totals: weighted sums are already global (the batch was the
         # global batch) — the reference needs 3 all-reduces here (ref :251-253);
@@ -255,7 +268,7 @@ class Trainer:
         jax.block_until_ready(epoch_metrics["weight"])
         epoch_time = time.time() - t_epoch
         loss, acc = summarize(epoch_metrics)
-        return state, loss, acc, epoch_time
+        return state, loss, acc, epoch_time, steps_done
 
     def evaluate(self, state: TrainState, batches: Iterable) -> Tuple[float, float]:
         """Sharded validation (maps validate, ref :266-300)."""

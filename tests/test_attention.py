@@ -123,11 +123,114 @@ class TestFlashAttention:
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 rtol=0.1, atol=0.5)
 
-    def test_adapter_rejects_mask(self):
+    def test_adapter_general_mask_falls_back_to_einsum(self):
+        """A mask with (Sq, Sk) structure has no blockwise formulation here;
+        the adapter must fall back to the XLA path (bit-equal), not error —
+        the fast path narrowing to a ValueError on real data was r3 weak-#3."""
         fn = make_flash_attention_fn(causal=True)
-        q, k, v = _rand_qkv(s=64)
-        with pytest.raises(ValueError, match="mask"):
-            fn(q, k, v, mask=jnp.ones((1, 1, 64, 64), bool))
+        q, k, v = _rand_qkv(b=2, s=64)
+        rng = np.random.RandomState(7)
+        general = jnp.asarray(rng.rand(2, 1, 64, 64) > 0.3)
+        out = fn(q, k, v, mask=general)
+        cm = jnp.tril(jnp.ones((64, 64), bool))[None, None]
+        expect = dot_product_attention(q, k, v, mask=general & cm)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(expect))
+
+
+class TestFlashPaddingMask:
+    """Key-padding masks ride the Pallas kernels (VERDICT r3 #2): BERT on
+    real padded batches must keep the flash path, gradients included."""
+
+    def _padded_mask(self, b, s, n_pad, front=False):
+        valid = np.ones((b, s), np.float32)
+        if front:
+            valid[:, :n_pad] = 0.0  # all-masked FIRST blocks: the online
+            # softmax accumulates p=1 garbage until the first live block
+            # rescales it to 0 — the hard case for the m=NEG_INF init
+        else:
+            valid[:, s - n_pad:] = 0.0
+        return jnp.asarray(valid)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("front", [False, True])
+    def test_padded_forward_matches_reference(self, causal, front):
+        q, k, v = _rand_qkv(b=2, s=128)
+        kv_valid = self._padded_mask(2, 128, 40, front)
+        out = flash_attention(q, k, v, causal, None, 64, 64, kv_valid)
+        mask = kv_valid[:, None, None, :].astype(bool)
+        if causal:
+            mask = mask & jnp.tril(jnp.ones((128, 128), bool))[None, None]
+        expect = dot_product_attention(q, k, v, mask=mask)
+        valid_rows = np.asarray(kv_valid, bool) if causal else \
+            np.ones((2, 128), bool)
+        # padded-out query rows emit garbage by contract (loss zero-weights
+        # them); compare only rows with at least one live key
+        np.testing.assert_allclose(
+            np.asarray(out)[valid_rows], np.asarray(expect)[valid_rows],
+            rtol=2e-5, atol=2e-5)
+
+    def test_padded_gradients_match_reference(self):
+        """Grad parity under the real contract: the loss zero-weights padded
+        query rows, so their garbage output contributes no cotangent."""
+        q, k, v = _rand_qkv(b=2, s=128, h=2, d=16, seed=5)
+        kv_valid = self._padded_mask(2, 128, 48)
+        w = kv_valid[:, :, None, None]  # zero-weight padded query rows
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, False, None, 64, 64, kv_valid)
+            return ((out * w) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            mask = kv_valid[:, None, None, :].astype(bool)
+            return ((dot_product_attention(q, k, v, mask=mask) * w) ** 2).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} diverges (padded)")
+        # no gradient may leak into padded K/V positions
+        pad = np.asarray(kv_valid) == 0
+        for g, name in ((g_flash[1], "dk"), (g_flash[2], "dv")):
+            leaked = np.abs(np.asarray(g)[pad]).max()
+            assert leaked < 1e-6, f"{name} leaks {leaked} into padding"
+
+    def test_long_context_padded_grad_parity_s4096(self):
+        """The S=4096 grad-parity bar from r2/r3, now with padded rows
+        (VERDICT r3 #2's done-criterion)."""
+        q, k, v = _rand_qkv(b=1, s=4096, h=1, d=64, seed=6)
+        kv_valid = self._padded_mask(1, 4096, 512)
+        w = kv_valid[:, :, None, None]
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, True, None, 512, 512, kv_valid)
+            return ((out * w) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            mask = kv_valid[:, None, None, :].astype(bool) & \
+                jnp.tril(jnp.ones((4096, 4096), bool))[None, None]
+            return ((dot_product_attention(q, k, v, mask=mask) * w) ** 2).sum()
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_flash, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
+                err_msg=f"d{name} diverges at S=4096 (padded)")
+
+    def test_adapter_padding_mask_takes_kernel_path(self):
+        """The (B, 1, 1, Sk) padding_mask form must ride the kernel, and
+        match the einsum path on the valid rows."""
+        from distributed_pytorch_training_tpu.models.layers import padding_mask
+
+        q, k, v = _rand_qkv(b=2, s=64)
+        am = self._padded_mask(2, 64, 16)
+        fn = make_flash_attention_fn(causal=False, block_q=32, block_k=32)
+        out = fn(q, k, v, mask=padding_mask(am))
+        expect = dot_product_attention(q, k, v, mask=padding_mask(am))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
 
 
 class TestRingAttention:
@@ -186,18 +289,124 @@ class TestModelKernelIntegration:
         np.testing.assert_allclose(np.asarray(out_xla), np.asarray(out_flash),
                                    rtol=3e-4, atol=3e-4)
 
-    def test_gpt2_kernel_path_rejects_padding_mask(self):
+    def test_gpt2_flash_with_padding_mask_matches_xla(self):
+        """Padded batches keep the flash path end-to-end through the model
+        (r3 weak-#3: the fast path used to narrow exactly where real data
+        begins). Valid-position logits must match the einsum path."""
         from distributed_pytorch_training_tpu.models import get_model
 
-        ids = jnp.zeros((1, 32), jnp.int32)
+        rng = np.random.RandomState(1)
+        ids = jnp.asarray(rng.randint(0, 1000, (2, 64)))
+        am = np.ones((2, 64), np.float32)
+        am[:, 48:] = 0.0
+        am = jnp.asarray(am)
+
+        m_xla = get_model("gpt2_124m", max_position=64)
+        variables = m_xla.init(jax.random.PRNGKey(0), ids, train=False)
+        out_xla = m_xla.apply(variables, ids, attention_mask=am, train=False)
+
+        m_flash = get_model("gpt2_124m", max_position=64,
+                            attention_fn=make_flash_attention_fn(
+                                causal=True, block_q=32, block_k=32))
+        out_flash = m_flash.apply(variables, ids, attention_mask=am,
+                                  train=False)
+        valid = np.asarray(am, bool)
+        np.testing.assert_allclose(np.asarray(out_xla)[valid],
+                                   np.asarray(out_flash)[valid],
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_gpt2_ring_path_still_rejects_padding_mask(self):
+        from distributed_pytorch_training_tpu.models import get_model
+
+        ids = jnp.zeros((8, 32), jnp.int32)
         m = get_model("gpt2_124m", max_position=32,
-                      attention_fn=make_flash_attention_fn(causal=True,
-                                                           block_q=32,
-                                                           block_k=32))
+                      attention_fn=make_ring_attention_fn(
+                          build_mesh(MeshSpec(data=8)), causal=True))
         variables = m.init(jax.random.PRNGKey(0), ids, train=False)
-        with pytest.raises(ValueError, match="padding masks"):
-            m.apply(variables, ids, attention_mask=jnp.ones((1, 32)),
+        with pytest.raises(ValueError, match="mask"):
+            m.apply(variables, ids, attention_mask=jnp.ones((8, 32)),
                     train=False)
+
+
+class TestRingFlashFused:
+    """The fused ring+flash path (VERDICT r3 #4): each ring step runs the
+    Pallas blockwise kernel (interpreter mode on CPU), partials merge via
+    fp32 lse, the backward re-runs the ring with the flash grad kernels.
+    Must be numerically interchangeable with the einsum ring."""
+
+    @pytest.fixture(scope="class")
+    def seq_mesh(self, devices):
+        return build_mesh(MeshSpec(data=2, seq=4), devices=devices)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fused_matches_reference(self, seq_mesh, causal):
+        q, k, v = _rand_qkv(b=2, s=128, h=2, d=16)  # S_loc=32
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, seq_mesh, causal=causal, use_pallas=True,
+            block_q=32, block_k=32))(q, k, v)
+        expect = _ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_fused_gradients_match_reference(self, seq_mesh):
+        q, k, v = _rand_qkv(b=2, s=64, h=2, d=8, seed=2)  # S_loc=16
+
+        def loss_fused(q, k, v):
+            return (ring_attention(q, k, v, seq_mesh, causal=True,
+                                   use_pallas=True, block_q=16,
+                                   block_k=16) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (_ref(q, k, v, True) ** 2).sum()
+
+        g_f = jax.jit(jax.grad(loss_fused, argnums=(0, 1, 2)))(q, k, v)
+        g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g_f, g_r, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} (fused ring)")
+
+    def test_fused_path_runs_pallas_kernels(self, seq_mesh):
+        """The point of the fusion: the compiled step must contain the
+        Pallas kernel, not the einsum formulation (r3 weak-#4: 'flash
+        speed and ring scale-out don't compose')."""
+        q, k, v = _rand_qkv(b=2, s=128, h=2, d=16)
+
+        def count_pallas(jaxpr):
+            n = 0
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    n += 1
+                for key in ("jaxpr", "call_jaxpr"):
+                    sub = eqn.params.get(key) if eqn.params else None
+                    if sub is not None:
+                        n += count_pallas(getattr(sub, "jaxpr", sub))
+                for key in ("branches",):
+                    for s in (eqn.params.get(key) or ()):
+                        n += count_pallas(getattr(s, "jaxpr", s))
+            return n
+
+        fused = jax.make_jaxpr(lambda q, k, v: ring_attention(
+            q, k, v, seq_mesh, causal=True, use_pallas=True,
+            block_q=32, block_k=32))(q, k, v)
+        einsum = jax.make_jaxpr(lambda q, k, v: ring_attention(
+            q, k, v, seq_mesh, causal=True, use_pallas=False))(q, k, v)
+        assert count_pallas(fused.jaxpr) > 0
+        assert count_pallas(einsum.jaxpr) == 0
+
+    def test_auto_selection_logic(self, seq_mesh):
+        """On CPU backends auto must pick the einsum path (pallas would run
+        in interpreter mode — pure overhead); the TPU decision is
+        flash_supports_length on the SHARD length."""
+        from distributed_pytorch_training_tpu.ops.flash_attention import (
+            flash_backend_supported,
+        )
+
+        assert not flash_backend_supported()  # test backend is CPU
+        q, k, v = _rand_qkv(b=2, s=128, h=2, d=16)
+        jaxpr = jax.make_jaxpr(lambda q, k, v: ring_attention(
+            q, k, v, seq_mesh, causal=True))(q, k, v)  # use_pallas=None
+        assert "pallas_call" not in str(jaxpr)
 
 
 class TestRingAttentionChunked:

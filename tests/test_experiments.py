@@ -193,3 +193,34 @@ def test_experiment_gradsync_bert_smoke(capsys):
     out = capsys.readouterr().out
     assert "grad_sync_share_trace_pct" in out
     assert "all-reduce" in out
+
+
+def test_flash_causal_flops_use_kernel_cost_estimate():
+    """The analytic FLOPs instrument must use the kernel's own CostEstimate
+    (causal-aware: only live diagonal blocks), not one tile x the full grid
+    — the r3 advisor found causal attention MFU ~2x overcounted (ADVICE r3)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_training_tpu.experiments.flops import (
+        jaxpr_matmul_flops,
+    )
+    from distributed_pytorch_training_tpu.ops import flash_attention
+    from distributed_pytorch_training_tpu.ops.flash_attention import _live_pairs
+
+    b, s, h, d, blk = 1, 1024, 2, 64, 512
+    q = jnp.zeros((b, s, h, d), jnp.float32)
+
+    def fwd(q):
+        return flash_attention(q, q, q, True, None, blk, blk)
+
+    got = jaxpr_matmul_flops(fwd, q)
+    live = _live_pairs(s // blk, s // blk, blk, blk, True)  # 3 of 4 blocks
+    assert live == 3
+    expect = b * h * live * 4 * blk * blk * d
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    # and the non-causal kernel counts the full rectangle
+    got_full = jaxpr_matmul_flops(
+        lambda q: flash_attention(q, q, q, False, None, blk, blk), q)
+    np.testing.assert_allclose(got_full, b * h * 4 * 4 * blk * blk * d,
+                               rtol=1e-6)

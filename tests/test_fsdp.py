@@ -149,9 +149,9 @@ def test_fsdp_checkpoint_roundtrip(fsdp_mesh, tmp_path):
 
     # fresh template (same rules/mesh, different values)
     t2, template = _trainer(fsdp_mesh, GPT2LMHead.partition_rules())
-    restored, epoch = ckpt.restore_latest(template)
+    restored, epoch, step_in_epoch = ckpt.restore_latest(template)
     ckpt.close()
-    assert epoch == 1
+    assert epoch == 1 and step_in_epoch == 0
     assert int(restored.step) == 1
 
     qkv = restored.params["block0"]["attn"]["qkv"]["kernel"]

@@ -179,3 +179,85 @@ def test_moe_remat_trains(devices):
     }, mesh)
     st, m = tr._train_step(st, batch, jax.random.PRNGKey(1))
     assert np.isfinite(float(m["loss_sum"]))
+
+
+class TestSortedDispatchParity:
+    """The sort-based dispatch (VERDICT r3 #8) must be numerically
+    interchangeable with the dense-einsum oracle — outputs, gradients, and
+    the aux loss — while never materializing a (B, S, E, C) tensor."""
+
+    def _pair(self, b=2, s=64, d=16, e=4, top_k=2, cf=1.25, seed=0):
+        x = jnp.asarray(np.random.RandomState(seed).randn(b, s, d),
+                        jnp.float32)
+        kw = dict(num_experts=e, hidden_dim=32, top_k=top_k,
+                  capacity_factor=cf)
+        sort = MoeMlp(dispatch_mode="sorted", **kw)
+        dense = MoeMlp(dispatch_mode="einsum", **kw)
+        params = sort.init(jax.random.PRNGKey(0), x)  # same param tree
+        return x, sort, dense, params
+
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_outputs_match(self, top_k):
+        x, sort, dense, params = self._pair(top_k=top_k)
+        y_s = sort.apply(params, x)
+        y_d = dense.apply(params, x)
+        np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_outputs_match_under_capacity_pressure(self):
+        # cf low enough that experts overflow: the drop set (and hence the
+        # output) must be identical, which pins the priority order too
+        x, sort, dense, params = self._pair(e=2, top_k=2, cf=0.4, seed=3)
+        y_s = sort.apply(params, x)
+        y_d = dense.apply(params, x)
+        np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gradients_and_aux_match(self):
+        x, sort, dense, params = self._pair(seed=5)
+
+        def loss(mod):
+            def f(p, x):
+                y, aux = mod.apply(p, x, mutable=["losses"])
+                return (y ** 2).sum() + aux["losses"]["moe_aux"][0]
+            return f
+
+        l_s, g_s = jax.value_and_grad(loss(sort))(params, x)
+        l_d, g_d = jax.value_and_grad(loss(dense))(params, x)
+        np.testing.assert_allclose(float(l_s), float(l_d), rtol=1e-5)
+        flat_s = jax.tree_util.tree_leaves(g_s)
+        flat_d = jax.tree_util.tree_leaves(g_d)
+        for a, b in zip(flat_s, flat_d):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_no_dense_dispatch_tensor_in_jaxpr(self):
+        """The whole point: no intermediate carries the S x E x C blowup.
+        At E=32, S=256, cap=20 the dense path would build (1,256,32,20)
+        f32 tensors; assert nothing that big (or E*C-shaped vs S) exists."""
+        b, s, d, e = 1, 256, 16, 32
+        x = jnp.zeros((b, s, d), jnp.float32)
+        layer = MoeMlp(num_experts=e, hidden_dim=32, top_k=2,
+                       dispatch_mode="sorted")
+        params = layer.init(jax.random.PRNGKey(0), x)
+        jaxpr = jax.make_jaxpr(lambda p, x: layer.apply(p, x))(params, x)
+        cap = int(np.ceil(s * 2 / e * 1.25))
+        forbidden = b * s * e * cap  # the dense dispatch tensor's size
+        for eqn in jaxpr.jaxpr.eqns:
+            for v in eqn.outvars:
+                sz = int(np.prod(v.aval.shape)) if v.aval.shape else 1
+                assert sz < forbidden, (
+                    f"{eqn.primitive.name} materializes {v.aval.shape} — "
+                    "the S*E*C dispatch blowup the sorted path must avoid")
+
+    def test_32_experts_single_chip_shapes(self):
+        """A 32-expert MoE block runs (the r3 done-criterion) — and the
+        buffers stay O(E*C*d), not O(S*E*C)."""
+        x = jnp.asarray(np.random.RandomState(7).randn(2, 128, 32),
+                        jnp.float32)
+        layer = MoeMlp(num_experts=32, hidden_dim=64, top_k=2)
+        params = layer.init(jax.random.PRNGKey(1), x)
+        y, aux = layer.apply(params, x, mutable=["losses"])
+        assert y.shape == x.shape
+        assert np.isfinite(np.asarray(y)).all()
+        assert np.isfinite(float(aux["losses"]["moe_aux"][0]))

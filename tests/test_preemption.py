@@ -1,9 +1,13 @@
 """Preemption guard (training/preemption.py): SIGTERM requests a graceful
-stop; train.py checkpoints at the epoch boundary and a relaunch resumes."""
+stop; train.py checkpoints — step-granular since r4 — and a relaunch
+resumes the exact trajectory."""
 
 import os
 import signal
 import threading
+
+import jax
+import numpy as np
 
 from distributed_pytorch_training_tpu.training.preemption import (
     PreemptionGuard,
@@ -50,6 +54,74 @@ def test_disarm_cancels_hard_deadline(monkeypatch):
     guard.disarm()  # graceful path completed promptly
     assert not fired.wait(timeout=0.8), "deadline fired after disarm"
     guard.reset()
+
+
+def test_midepoch_resume_matches_uninterrupted_trajectory(tmp_path, mesh8):
+    """The r3 story lost up to an epoch on preemption (VERDICT r3 #5). Now:
+    stop after k steps MID-epoch, checkpoint (epoch, step), restore into a
+    fresh state, resume at start_step=k — the final params must be
+    bit-identical to a never-interrupted run. Pins the whole chain:
+    deterministic sampler offset + state.step-folded RNG + (epoch, step)
+    metadata."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    from test_training import _tiny_setup
+
+    from distributed_pytorch_training_tpu.data.datasets import ArrayDataset
+    from distributed_pytorch_training_tpu.data.loader import ShardedLoader
+    from distributed_pytorch_training_tpu.training.checkpoint import (
+        CheckpointManager,
+    )
+
+    trainer, state0, images, labels = _tiny_setup(mesh8, n=64)
+    ds = ArrayDataset(images=images, labels=labels, num_classes=4,
+                      name="tiny", synthetic=True)
+    loader = ShardedLoader(ds, mesh8, per_device_batch=2, shuffle=True,
+                           seed=0)  # 64 / (2*8) = 4 steps per epoch
+    spe = len(loader)
+    assert spe == 4
+
+    # --- run A: uninterrupted, 2 epochs -----------------------------------
+    state_a = state0
+    for epoch in range(2):
+        state_a, *_ = trainer.train_epoch(
+            state_a, loader.epoch(epoch), epoch, spe)
+
+    # --- run B: stop after 2 steps of epoch 0, checkpoint, resume ---------
+    state_b = state0
+    executed = [0]
+
+    def stop_after_two():
+        executed[0] += 1
+        return executed[0] >= 2
+
+    state_b, _, _, _, steps_done = trainer.train_epoch(
+        state_b, loader.epoch(0), 0, spe, stop_fn=stop_after_two)
+    assert steps_done == 2  # genuinely mid-epoch
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(0 * spe + steps_done, state_b, wait=True, epoch=0,
+             step_in_epoch=steps_done)
+
+    # fresh process stand-in: new template state, restore coordinates
+    _, template, _, _ = _tiny_setup(mesh8, n=64)
+    restored, r_epoch, r_step = mgr.restore_latest(template)
+    mgr.close()
+    assert (r_epoch, r_step) == (0, 2)
+
+    state_b = restored
+    for epoch in range(r_epoch, 2):
+        start = r_step if epoch == r_epoch else 0
+        state_b, *_ = trainer.train_epoch(
+            state_b, loader.epoch(epoch, start_step=start), epoch, spe,
+            start_step=start)
+
+    assert int(state_b.step) == int(state_a.step)
+    for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                    jax.tree_util.tree_leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_cli_checkpoints_on_preemption(tmp_path, mesh8):

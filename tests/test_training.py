@@ -201,8 +201,8 @@ class TestCheckpoint:
         _, template, _, _ = _tiny_setup(mesh8)
         restored = mgr.restore_latest(template)
         assert restored is not None
-        rstate, epoch = restored
-        assert epoch == 1 and int(rstate.step) == 1
+        rstate, epoch, step_in_epoch = restored
+        assert epoch == 1 and step_in_epoch == 0 and int(rstate.step) == 1
         for a, b in zip(jax.tree_util.tree_leaves(rstate.params),
                         jax.tree_util.tree_leaves(state.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

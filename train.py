@@ -96,6 +96,18 @@ def main(argv=None):
         PreemptionGuard,
     )
     guard = PreemptionGuard.install()
+    try:
+        _run(args, guard)
+    finally:
+        # The hard-exit deadline must not outlive this invocation: an
+        # embedder (sweep / notebook) that catches a failure mid-preemption
+        # would otherwise be os._exit(143)-killed up to `grace` seconds
+        # later with no warning. Normal completion disarms after cleanup
+        # inside _run; this is the exception path.
+        guard.disarm()
+
+
+def _run(args, guard):
     Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
 
     ctx = setup_distributed()  # ref :318
@@ -303,8 +315,11 @@ def main(argv=None):
             log_main(f"NOTE: MFU logging disabled ({e})")
 
     # Checkpointing (extension; the reference has none — SURVEY.md §5).
+    # Step-granular: labels are epoch * steps_per_epoch + step, so a
+    # mid-epoch preemption save sorts between the epoch boundaries and
+    # resume continues at that exact step (deterministic sampler).
     ckpt = None
-    start_epoch = 0
+    start_epoch = start_step = 0
     if args.checkpoint_dir:
         from distributed_pytorch_training_tpu.training.checkpoint import (
             CheckpointManager,
@@ -313,8 +328,11 @@ def main(argv=None):
         if args.resume:
             restored = ckpt.restore_latest(state)
             if restored is not None:
-                state, start_epoch = restored
-                log_main(f"Resumed from epoch {start_epoch}")
+                state, start_epoch, start_step = restored
+                if start_step >= steps_per_epoch:  # stale steps_per_epoch
+                    start_epoch, start_step = start_epoch + 1, 0
+                log_main(f"Resumed from epoch {start_epoch}"
+                         + (f" step {start_step}" if start_step else ""))
 
     csv = MetricsCSV(args.output_dir)  # ref :349-354
 
@@ -328,9 +346,30 @@ def main(argv=None):
     for epoch in range(start_epoch, args.epochs):  # ref :356
         counts = samples_per_step_list(len(train_ds), global_batch,
                                        steps_per_epoch, args.drop_last)
-        state, train_loss, train_acc, epoch_time = trainer.train_epoch(
-            state, train_loader.epoch(epoch), epoch, steps_per_epoch,
-            samples_per_step=counts, step_hook=profiler)
+        state, train_loss, train_acc, epoch_time, steps_done = \
+            trainer.train_epoch(
+                state, train_loader.epoch(epoch, start_step=start_step),
+                epoch, steps_per_epoch,
+                samples_per_step=counts[start_step:], step_hook=profiler,
+                start_step=start_step,
+                stop_fn=lambda: guard.should_stop)
+        abs_step = start_step + steps_done
+        start_step = 0
+
+        if guard.should_stop and abs_step < steps_per_epoch:
+            # Preempted MID-epoch: persist (epoch, step) immediately — a
+            # resume replays nothing (the r3 story lost up to an epoch,
+            # VERDICT r3 #5). No CSV row: the epoch is incomplete.
+            if ckpt:
+                ckpt.save(epoch * steps_per_epoch + abs_step, state,
+                          wait=True, epoch=epoch, step_in_epoch=abs_step)
+                log_main(f"Preempted: checkpointed epoch {epoch} step "
+                         f"{abs_step}/{steps_per_epoch}; relaunch with "
+                         "--resume to continue mid-epoch")
+            else:
+                log_main("Preempted: stopping (no --checkpoint-dir, "
+                         "nothing persisted beyond the metrics CSV)")
+            break
 
         val_loss, val_acc = trainer.evaluate(state, val_loader.epoch(0))
 
@@ -344,12 +383,13 @@ def main(argv=None):
         csv.append(epoch, train_loss, train_acc, val_loss, val_acc, epoch_time)
 
         if ckpt and (epoch + 1) % args.checkpoint_every == 0:
-            ckpt.save(epoch + 1, state)
+            ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1)
 
         if guard.should_stop:
             if ckpt:
                 if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
-                    ckpt.save(epoch + 1, state)
+                    ckpt.save((epoch + 1) * steps_per_epoch, state,
+                              epoch=epoch + 1)
                 ckpt.wait()
                 log_main(f"Preempted: checkpointed epoch {epoch + 1}; "
                          "relaunch with --resume to continue")
