@@ -22,7 +22,9 @@ from ..parallel.sharding import PartitionRules
 from .layers import (
     MlpBlock,
     MultiHeadAttention,
+    VocabPaddingMixin,
     dot_product_attention,
+    mask_vocab_padding,
     padding_mask,
     tp_fsdp_rules,
 )
@@ -58,7 +60,7 @@ class BertBlock(nn.Module):
         return ln(name="ln2")(x + y)
 
 
-class BertForMaskedLM(nn.Module):
+class BertForMaskedLM(VocabPaddingMixin, nn.Module):
     vocab_size: int = 30522
     hidden_dim: int = 768
     depth: int = 12
@@ -72,12 +74,16 @@ class BertForMaskedLM(nn.Module):
     layernorm_epsilon: float = 1e-12
     attention_fn: Callable = dot_product_attention
     remat: bool = False  # jax.checkpoint each block: HBM for recompute FLOPs
+    # Megatron-style vocab padding for TP (see models/gpt2.py): lets the
+    # token embedding shard over `model`; padded columns masked out of the
+    # logits. 0 = exact HF shapes.
+    pad_vocab_to_multiple_of: int = 0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  train: bool = False):
         b, s = input_ids.shape
-        tok = nn.Embed(self.vocab_size, self.hidden_dim,
+        tok = nn.Embed(self.padded_vocab, self.hidden_dim,
                        dtype=self.dtype, param_dtype=self.param_dtype,
                        name="token_embedding")
         x = tok(input_ids)
@@ -112,10 +118,15 @@ class BertForMaskedLM(nn.Module):
         h = nn.gelu(h)
         h = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="mlm_ln")(h)
-        logits = tok.attend(h)  # tied decoder: (B, S, vocab)
+        logits = tok.attend(h)  # tied decoder: (B, S, padded vocab)
+        # Bias stays at the HF-exact (vocab,) shape (it is replicated — no
+        # sharding need); pad with zeros to match the padded logit width.
         bias = self.param("mlm_bias", nn.initializers.zeros,
                           (self.vocab_size,), self.param_dtype)
-        return (logits + bias).astype(jnp.float32)
+        if self.padded_vocab != self.vocab_size:
+            bias = jnp.pad(bias, (0, self.padded_vocab - self.vocab_size))
+        return mask_vocab_padding((logits + bias).astype(jnp.float32),
+                                  self.vocab_size)
 
     @staticmethod
     def partition_rules() -> PartitionRules:

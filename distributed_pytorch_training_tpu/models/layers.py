@@ -134,6 +134,45 @@ class TransformerBlock(nn.Module):
         return x + y
 
 
+def padded_vocab_size(vocab_size: int, multiple: int) -> int:
+    """Megatron-style vocab padding: the smallest multiple of `multiple`
+    >= vocab_size. GPT-2's 50257 is indivisible by any TP degree, so the
+    (vocab, d) embedding — the model's largest tensor — could never shard
+    over the `model` axis without this (it would silently replicate, see
+    parallel/sharding.feasible_spec). 0 or 1 disables padding."""
+    if multiple <= 1:
+        return vocab_size
+    return -(-vocab_size // multiple) * multiple
+
+
+class VocabPaddingMixin:
+    """Shared accessors for Megatron-style vocab padding. Models declare the
+    ``pad_vocab_to_multiple_of: int = 0`` field themselves (flax's dataclass
+    transform requires fields on the Module subclass); this mixin supplies
+    the derived quantities so the padding formula lives in one place."""
+
+    @property
+    def padded_vocab(self) -> int:
+        return padded_vocab_size(self.vocab_size, self.pad_vocab_to_multiple_of)
+
+    @property
+    def vocab_pad_params(self) -> int:
+        """Extra params introduced by vocab padding (for HF-exact reporting)."""
+        return (self.padded_vocab - self.vocab_size) * self.hidden_dim
+
+
+def mask_vocab_padding(logits: jnp.ndarray, vocab_size: int) -> jnp.ndarray:
+    """Neutralize padded vocab columns: set their logits to the dtype min so
+    softmax assigns them exactly zero probability (exp underflows to 0.0)
+    and argmax never selects them. With that, CE loss / token accuracy over
+    a padded head are bit-identical to the unpadded head."""
+    padded = logits.shape[-1]
+    if padded == vocab_size:
+        return logits
+    keep = jnp.arange(padded) < vocab_size
+    return jnp.where(keep, logits, jnp.finfo(logits.dtype).min)
+
+
 def causal_mask(seq_len: int) -> jnp.ndarray:
     """(1, 1, S, S) lower-triangular True=attend mask."""
     return jnp.tril(jnp.ones((seq_len, seq_len), bool))[None, None]

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..parallel.mesh import EXPERT
 from ..parallel.sharding import PartitionRules
+from .layers import VocabPaddingMixin
 from .registry import register_model
 from jax.sharding import PartitionSpec as P
 
@@ -238,7 +239,7 @@ class MoeTransformerBlock(nn.Module):
         return x + y
 
 
-class GPT2MoELMHead(nn.Module):
+class GPT2MoELMHead(VocabPaddingMixin, nn.Module):
     """GPT-2-style causal LM with MoE feed-forwards on alternating layers
     (the Switch/GShard layout: dense and MoE blocks interleave)."""
 
@@ -261,13 +262,15 @@ class GPT2MoELMHead(nn.Module):
     # aux-loss into the "losses" collection, which remat would complicate;
     # half the layers is still half the activation memory.
     remat: bool = False
+    # Megatron-style vocab padding for TP (see models/gpt2.py). 0 = exact.
+    pad_vocab_to_multiple_of: int = 0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = False):
         from .layers import TransformerBlock, causal_mask, dot_product_attention
 
         b, s = input_ids.shape
-        wte = nn.Embed(self.vocab_size, self.hidden_dim, dtype=self.dtype,
+        wte = nn.Embed(self.padded_vocab, self.hidden_dim, dtype=self.dtype,
                        param_dtype=self.param_dtype,
                        embedding_init=nn.initializers.normal(stddev=0.02),
                        name="wte")
@@ -316,7 +319,10 @@ class GPT2MoELMHead(nn.Module):
 
         x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
-        return wte.attend(x).astype(jnp.float32)
+        from .layers import mask_vocab_padding
+
+        return mask_vocab_padding(wte.attend(x).astype(jnp.float32),
+                                  self.vocab_size)
 
     @staticmethod
     def partition_rules() -> PartitionRules:

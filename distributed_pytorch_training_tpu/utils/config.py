@@ -44,6 +44,12 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
     parser.add_argument("--model", default="resnet18", type=str,
                         help="model name (resnet18/resnet50/vit_b16/bert_base/"
                              "gpt2_124m/gpt2_355m/gpt2_moe)")
+    parser.add_argument("--model-overrides", default="", type=str,
+                        help="comma-separated field=value constructor "
+                             "overrides, e.g. 'depth=2,hidden_dim=64' — "
+                             "shrunk-architecture runs of a named config "
+                             "(CPU sanity, CI); values parse as int/float "
+                             "when they look numeric")
     parser.add_argument("--dataset", default="cifar10", type=str,
                         help="dataset name (cifar10/imagenet)")
     parser.add_argument("--download", action="store_true",
@@ -104,3 +110,23 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="start,stop step of the profiled window")
 
     return parser.parse_args(argv)
+
+
+def parse_model_overrides(spec: str) -> dict:
+    """'depth=2,hidden_dim=64' -> {'depth': 2, 'hidden_dim': 64}. Values
+    parse as int, then float, then bool ('true'/'false'), else string."""
+    out: dict = {}
+    for item in filter(None, (s.strip() for s in spec.split(","))):
+        if "=" not in item:
+            raise ValueError(
+                f"--model-overrides entry {item!r} is not field=value")
+        key, val = (s.strip() for s in item.split("=", 1))
+        for cast in (int, float):
+            try:
+                out[key] = cast(val)
+                break
+            except ValueError:
+                continue
+        else:
+            out[key] = {"true": True, "false": False}.get(val.lower(), val)
+    return out
