@@ -120,11 +120,15 @@ class Trainer:
         #   per-microbatch aux losses, whereas grad_accum=1 computes routing
         #   statistics over the full batch. Inherent to accumulation, not a
         #   bug — per-microbatch balancing is itself a valid regularizer.
-        if jax.tree_util.tree_leaves(state.batch_stats):
-            raise ValueError(
-                "grad_accum > 1 does not support batch-stats models "
-                "(BatchNorm EMAs would update per microbatch); use a "
-                "stat-free model or grad_accum=1")
+        # * BatchNorm models (ResNets): each microbatch normalizes by ITS
+        #   OWN statistics (exactly torch's behavior under accumulation), so
+        #   grads differ from the full-batch step by the (small, O(1/|mb|))
+        #   between-microbatch variance. Running stats stay unbiased: every
+        #   microbatch EMA starts from the SAME pre-step stats (state is
+        #   closed over, not carried), so the weighted mean of the per-
+        #   microbatch EMAs equals ONE EMA update with the weighted-mean
+        #   batch statistics — not `accum` compounding updates.
+        has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
 
         def split(x):
             if x.ndim == 0:
@@ -151,24 +155,39 @@ class Trainer:
             return jax.grad(loss_fn, has_aux=True)(state.params)
 
         def body(carry, xs):
-            g_sum, m_sum = carry
+            g_sum, s_sum, m_sum = carry
             mb, key = xs
-            g, (m, _) = micro_grads(mb, key)
+            g, (m, new_stats) = micro_grads(mb, key)
             w = m["weight"]
             g_sum = jax.tree_util.tree_map(
                 lambda a, b: a + w * b.astype(a.dtype), g_sum, g)
+            if has_stats:
+                s_sum = jax.tree_util.tree_map(
+                    lambda a, b: a + w * b.astype(a.dtype), s_sum, new_stats)
             m_sum = add_metrics(m_sum, m)
-            return (g_sum, m_sum), None
+            return (g_sum, s_sum, m_sum), None
 
         g0 = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        s0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, jnp.float32), state.batch_stats)
         keys = jax.random.split(rng, accum)
-        (g_sum, metrics), _ = jax.lax.scan(
-            body, (g0, zero_metrics()), (micro_batches, keys))
+        (g_sum, s_sum, metrics), _ = jax.lax.scan(
+            body, (g0, s0, zero_metrics()), (micro_batches, keys))
         total_w = jnp.maximum(metrics["weight"], 1.0)
         grads = jax.tree_util.tree_map(
             lambda g, p: (g / total_w).astype(p.dtype), g_sum, state.params)
-        new_state = state.apply_gradients(grads)
+        if has_stats:
+            # A fully-padded global batch (weight 0) must keep the old
+            # stats, not zero them (grads are already a no-op then).
+            new_stats = jax.tree_util.tree_map(
+                lambda s, old: jnp.where(metrics["weight"] > 0, s / total_w,
+                                         old.astype(jnp.float32)
+                                         ).astype(old.dtype),
+                s_sum, state.batch_stats)
+        else:
+            new_stats = state.batch_stats
+        new_state = state.apply_gradients(grads, batch_stats=new_stats)
         return new_state, metrics
 
     def _eval_step_impl(self, state: TrainState, batch):

@@ -115,6 +115,36 @@ class TestVocabPaddingTP:
                                    self._loss(unpadded, params, ids),
                                    rtol=1e-7)
 
+    def test_bert_padded_head_matches_unpadded(self):
+        """BERT's padding path has a bespoke branch (mlm_bias stays at the
+        HF-exact (vocab,) shape and is zero-padded at apply time): real
+        columns identical, pads masked."""
+        from distributed_pytorch_training_tpu.models.bert import (
+            BertForMaskedLM,
+        )
+
+        tiny = dict(vocab_size=30522, hidden_dim=16, depth=1, num_heads=2,
+                    mlp_dim=32, max_position=16)
+        unpadded = BertForMaskedLM(**tiny)
+        padded = BertForMaskedLM(**tiny, pad_vocab_to_multiple_of=128)
+        assert padded.padded_vocab == 30592
+        ids = jnp.asarray(
+            np.random.RandomState(3).randint(0, 30522, (2, 16)), jnp.int32)
+        params = unpadded.init(jax.random.PRNGKey(0), ids, train=False)["params"]
+        n_pad = 30592 - 30522
+        params_p = dict(params)
+        params_p["token_embedding"] = {"embedding": jnp.pad(
+            params["token_embedding"]["embedding"], ((0, n_pad), (0, 0)))}
+        assert params_p["mlm_bias"].shape == (30522,)  # bias stays HF-exact
+
+        out_u = unpadded.apply({"params": params}, ids, train=False)
+        out_p = padded.apply({"params": params_p}, ids, train=False)
+        assert out_p.shape[-1] == 30592
+        np.testing.assert_array_equal(np.asarray(out_p[..., :30522]),
+                                      np.asarray(out_u))
+        assert np.all(np.asarray(out_p[..., 30522:])
+                      == np.finfo(np.float32).min)
+
 
 @pytest.mark.parametrize("make_fn", [make_ring_attention_fn,
                                      make_ulysses_attention_fn])

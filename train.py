@@ -125,6 +125,7 @@ def _run(args, guard):
     )
 
     compute_dtype = jnp.bfloat16 if args.amp else jnp.float32
+    overrides = parse_model_overrides(args.model_overrides)
     is_lm = args.model.startswith(("gpt2", "bert"))
     family = "bert" if args.model.startswith("bert") else "gpt2"
     resolved_seq = args.seq_len or (512 if family == "bert" else 1024)
@@ -204,7 +205,7 @@ def _run(args, guard):
 
             lm_kwargs["pad_vocab_to_multiple_of"] = math.lcm(
                 128, mesh.shape["model"])
-        lm_kwargs.update(parse_model_overrides(args.model_overrides))
+        lm_kwargs.update(overrides)
         if attention != "xla":
             if family == "bert" and attention in ("ring", "ulysses"):
                 raise ValueError("--attention ring/ulysses is causal-only; "
@@ -244,14 +245,21 @@ def _run(args, guard):
 
             pipelined = True
             # config holder for the named size (+ any CLI shrink overrides)
-            cfg = get_model(args.model,
-                            **parse_model_overrides(args.model_overrides))
-            model = GPT2PipeLMHead(
+            cfg = get_model(args.model, **overrides)
+            pipe_kwargs = dict(
                 mesh=mesh, num_microbatches=args.microbatches,
                 vocab_size=cfg.vocab_size, hidden_dim=cfg.hidden_dim,
                 depth=cfg.depth, num_heads=cfg.num_heads,
                 max_position=max(cfg.max_position, seq_len),
                 dtype=compute_dtype, remat=args.remat)
+            # overrides of pipe-model fields beyond the explicit list above
+            # (e.g. layernorm_epsilon) must not be silently dropped
+            import dataclasses as _dc
+
+            pipe_fields = {f.name for f in _dc.fields(GPT2PipeLMHead)}
+            pipe_kwargs.update({k: v for k, v in overrides.items()
+                                if k in pipe_fields and k not in pipe_kwargs})
+            model = GPT2PipeLMHead(**pipe_kwargs)
         else:
             model = get_model(args.model, **lm_kwargs)
         if family == "bert":
@@ -271,7 +279,7 @@ def _run(args, guard):
                                    seed=args.seed, prefetch=2)
         mean, std = IMAGE_STATS[args.dataset.lower()]
         model_kwargs = dict(num_classes=train_ds.num_classes, dtype=compute_dtype)
-        model_kwargs.update(parse_model_overrides(args.model_overrides))
+        model_kwargs.update(overrides)
         if args.model.startswith("resnet"):
             # explicit --model-overrides wins over the dedicated flag
             model_kwargs.setdefault("cifar_stem", args.cifar_stem)
