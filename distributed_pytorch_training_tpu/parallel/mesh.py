@@ -155,6 +155,46 @@ def _slice_count(devices: Sequence[jax.Device]) -> int:
     return max(1, len(ids))
 
 
+def _unwrap_devices(dev_array: np.ndarray) -> np.ndarray:
+    """Virtual-slice proxies (testing) are only for LAYOUT — every Mesh must
+    hold the real devices underneath, including on hybrid-construction
+    fallback paths."""
+    return np.array(
+        [getattr(d, "base_device", d) for d in dev_array.flat],
+        dtype=object).reshape(dev_array.shape)
+
+
+class _VirtualSliceDevice:
+    """A device dressed with a synthetic ``slice_index``.
+
+    Lets the multi-slice path (``dcn_factors`` ->
+    ``mesh_utils.create_hybrid_device_mesh``) run END-TO-END on hosts with
+    no multi-slice hardware (CPU test meshes, the driver's dry-run).
+    ``build_mesh`` unwraps ``base_device`` after the layout is computed, so
+    the resulting Mesh holds real devices and executes normally."""
+
+    def __init__(self, device, slice_index: int):
+        self.base_device = device
+        self.slice_index = slice_index
+
+    def __getattr__(self, name):
+        return getattr(self.base_device, name)
+
+    def __repr__(self):
+        return f"VirtualSlice({self.slice_index}, {self.base_device!r})"
+
+
+def with_virtual_slices(devices: Sequence[jax.Device],
+                        n_slices: int) -> list:
+    """Partition `devices` into `n_slices` equal contiguous virtual slices
+    (testing helper; see _VirtualSliceDevice)."""
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices")
+    per = len(devices) // n_slices
+    return [_VirtualSliceDevice(d, i // per) for i, d in enumerate(devices)]
+
+
 def build_mesh(
     spec: Optional[MeshSpec] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -184,7 +224,7 @@ def build_mesh(
                 tuple(per[a] for a in AXIS_ORDER),
                 tuple(dcn[a] for a in AXIS_ORDER),
                 devices=list(devices))
-            return Mesh(dev_array, AXIS_ORDER)
+            return Mesh(_unwrap_devices(dev_array), AXIS_ORDER)
         except (ValueError, AssertionError, NotImplementedError) as e:
             logging.getLogger(__name__).warning(
                 "hybrid mesh construction failed (%s); falling back to the "
@@ -196,7 +236,7 @@ def build_mesh(
     except (ValueError, AssertionError, NotImplementedError):
         # Non-TPU backends (CPU test meshes) or odd shapes: plain reshape.
         dev_array = np.asarray(list(devices)).reshape(shape)
-    return Mesh(dev_array, AXIS_ORDER)
+    return Mesh(_unwrap_devices(dev_array), AXIS_ORDER)
 
 
 def validate_mesh_usage(
