@@ -1,0 +1,72 @@
+"""Real-corpus LM end-to-end (VERDICT r4 next-round #7): a committed
+public-domain text file is byte-tokenized by the tokenize CLI, loaded from
+disk by the token pipeline (synthetic=False), and trained through the full
+`train.py` orchestration with decreasing loss — the LM counterpart of
+test_e2e.test_train_cli_end_to_end (ref train_ddp.py:314-390 shape, applied
+to the GPT-2 config family of BASELINE.json:12)."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+CORPUS = Path(__file__).parent / "data" / "corpus.txt"
+
+
+def test_tokenize_cli_writes_packed_layout(tmp_path):
+    """The tokenize tool's byte-level path: UTF-8 bytes become the token
+    ids, split into {family}_train.npy / {family}_val.npy."""
+    from distributed_pytorch_training_tpu.data.tokenize import main
+
+    assert main([str(CORPUS), "--tokenizer", "bytes", "--family", "gpt2",
+                 "--out", str(tmp_path), "--val-fraction", "0.1"]) == 0
+    train = np.load(tmp_path / "gpt2_train.npy")
+    val = np.load(tmp_path / "gpt2_val.npy")
+    raw = CORPUS.read_bytes()
+    assert len(train) + len(val) == len(raw)
+    # the tokens ARE the file's bytes, in order
+    np.testing.assert_array_equal(train[:64],
+                                  np.frombuffer(raw[:64], np.uint8))
+    assert train.max() < 256
+
+
+@pytest.mark.slow
+def test_train_cli_lm_on_real_corpus(tmp_path, capsys):
+    """CLI-level GPT-2 run on disk tokens: tokenize -> train 2 epochs with a
+    shrunk gpt2_124m -> CSV shows decreasing train loss, and the run must
+    NOT have fallen back to synthetic data."""
+    import train
+
+    from distributed_pytorch_training_tpu.data.tokenize import main as tok
+
+    data_dir = tmp_path / "data"
+    tok([str(CORPUS), "--tokenizer", "bytes", "--family", "gpt2",
+         "--out", str(data_dir)])
+
+    out = tmp_path / "exp"
+    train.main([
+        "--model", "gpt2_124m",
+        # byte vocab: ids < 256, so a 256-entry embedding suffices and keeps
+        # the CPU run fast; depth/width shrunk per the named-config override
+        "--model-overrides",
+        "vocab_size=256,depth=2,hidden_dim=64,num_heads=2,max_position=64",
+        "--data-dir", str(data_dir), "--seq-len", "64",
+        # batch 2 x 8 batch shards = global 16 -> 5 steps/epoch on the
+        # ~4.3k-token train split, so the print-freq-2 throughput line fires
+        "--epochs", "2", "--batch-size", "2", "--lr", "0.001",
+        "--optimizer", "adamw", "--print-freq", "2", "--seed", "0",
+        "--output-dir", str(out),
+    ])
+    captured = capsys.readouterr().out
+    assert "synthetic" not in captured, "must train on the real corpus"
+    assert "Throughput:" in captured
+
+    lines = (out / "metrics_rank0.csv").read_text().strip().splitlines()
+    assert lines[0] == ("epoch,train_loss,train_acc,val_loss,val_acc,"
+                        "epoch_time_seconds")
+    rows = [line.split(",") for line in lines[1:]]
+    assert [r[0] for r in rows] == ["1", "2"]
+    # real-text byte LM: loss must fall across epochs, from a plausible
+    # byte-entropy starting point (ln 256 ~ 5.55 at init)
+    assert float(rows[1][1]) < float(rows[0][1])
+    assert float(rows[0][1]) < 6.0
