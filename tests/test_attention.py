@@ -65,6 +65,7 @@ class TestFlashAttention:
         assert _fit_block(4, 2048) == 8
         assert _fit_block(512, 1032) == 344  # >= s//8 floor keeps the grid sane
 
+    @pytest.mark.slow
     def test_gradients_match_reference(self):
         q, k, v = _rand_qkv(b=1, s=64, h=2, d=16)
 
@@ -81,6 +82,7 @@ class TestFlashAttention:
                                        rtol=2e-4, atol=2e-4)
 
 
+    @pytest.mark.slow
     def test_long_context_grad_parity_s4096(self):
         """S=4096 forward+backward through the blockwise Pallas kernels
         (interpreter mode) vs the XLA reference — the long-context bar from
@@ -102,6 +104,7 @@ class TestFlashAttention:
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3,
                 err_msg=f"d{name} diverges at S=4096")
 
+    @pytest.mark.slow
     def test_bf16_grad_parity(self):
         """bf16 inputs (the TPU compute dtype): kernel stats stay fp32, so
         grads must track the fp32-stat reference within bf16 tolerance."""
@@ -169,6 +172,7 @@ class TestFlashPaddingMask:
             np.asarray(out)[valid_rows], np.asarray(expect)[valid_rows],
             rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_padded_gradients_match_reference(self):
         """Grad parity under the real contract: the loss zero-weights padded
         query rows, so their garbage output contributes no cotangent."""
@@ -196,6 +200,7 @@ class TestFlashPaddingMask:
             leaked = np.abs(np.asarray(g)[pad]).max()
             assert leaked < 1e-6, f"{name} leaks {leaked} into padding"
 
+    @pytest.mark.slow
     def test_long_context_padded_grad_parity_s4096(self):
         """The S=4096 grad-parity bar from r2/r3, now with padded rows
         (VERDICT r3 #2's done-criterion)."""
@@ -247,6 +252,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_flow_through_ring(self, seq_mesh):
         q, k, v = _rand_qkv(b=2, s=32, h=2, d=8)
 
@@ -274,6 +280,7 @@ class TestRingAttention:
 
 
 class TestModelKernelIntegration:
+    @pytest.mark.slow
     def test_gpt2_flash_matches_xla(self):
         from distributed_pytorch_training_tpu.models import get_model
 
@@ -289,6 +296,7 @@ class TestModelKernelIntegration:
         np.testing.assert_allclose(np.asarray(out_xla), np.asarray(out_flash),
                                    rtol=3e-4, atol=3e-4)
 
+    @pytest.mark.slow
     def test_gpt2_flash_with_padding_mask_matches_xla(self):
         """Padded batches keep the flash path end-to-end through the model
         (r3 weak-#3: the fast path used to narrow exactly where real data
@@ -315,6 +323,7 @@ class TestModelKernelIntegration:
                                    np.asarray(out_flash)[valid],
                                    rtol=3e-4, atol=3e-4)
 
+    @pytest.mark.slow
     def test_gpt2_ring_path_still_rejects_padding_mask(self):
         from distributed_pytorch_training_tpu.models import get_model
 
@@ -348,6 +357,7 @@ class TestRingFlashFused:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_fused_gradients_match_reference(self, seq_mesh):
         q, k, v = _rand_qkv(b=2, s=64, h=2, d=8, seed=2)  # S_loc=16
 

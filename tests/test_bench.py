@@ -3,6 +3,8 @@ stdout; a hung or crashed backend must degrade to an error-JSON, never to
 silence (the round-1 bench lost its round to an unguarded backend hang)."""
 
 import json
+
+import pytest
 import os
 import subprocess
 import sys
@@ -26,6 +28,7 @@ def _run_bench(extra_args, env_extra=None, timeout=120):
     return proc, json_lines
 
 
+@pytest.mark.slow
 def test_watchdog_emits_error_json_when_backend_hangs():
     """A backend that blocks forever in init (observed live: a wedged
     tunnel made jax.devices() hang indefinitely) must not eat the round:
@@ -43,6 +46,7 @@ def test_watchdog_emits_error_json_when_backend_hangs():
     assert set(result) >= {"metric", "value", "unit", "vs_baseline"}
 
 
+@pytest.mark.slow
 def test_watchdog_salvages_flushed_result_json_on_deadline():
     """A result that was already measured and flushed must survive a
     deadline hit (e.g. the inner hangs in PJRT client teardown, or an
@@ -60,6 +64,7 @@ def test_watchdog_salvages_flushed_result_json_on_deadline():
     assert "error" not in result
 
 
+@pytest.mark.slow
 def test_wedged_probes_fail_inside_init_budget_not_at_deadline():
     """Round 3's actual failure: each in-process jax.devices() attempt
     blocked ~25 minutes, so five retries outlived the driver (rc=124).

@@ -1,6 +1,8 @@
 """Experiment tooling (experiments/scaling.py): the HLO collective census
 must find the all-reduce XLA inserts for a cross-device reduction."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -28,6 +30,7 @@ def test_census_empty_on_local_computation():
     assert collective_census(text) == []
 
 
+@pytest.mark.slow
 def test_trace_derived_collective_share(mesh8, tmp_path):
     """The jax.profiler trace parser must find the data-parallel all-reduce
     and report a share in (0, 100] — the README's '~X%' number, measured
@@ -73,24 +76,28 @@ _SMOKE = ["--batch-size", "8", "--steps", "1", "--repeats", "1",
           "--min-window-s", "0.01"]
 
 
+@pytest.mark.slow
 def test_experiment_scaling_smoke(capsys):
     _run_experiment(["scaling"] + _SMOKE)
     out = capsys.readouterr().out
     assert "scaling_efficiency_pct" in out
 
 
+@pytest.mark.slow
 def test_experiment_batch_smoke(capsys):
     _run_experiment(["batch"] + _SMOKE + ["--batch-list", "8,16"])
     out = capsys.readouterr().out
     assert "per_device_batch" in out
 
 
+@pytest.mark.slow
 def test_experiment_amp_smoke(capsys):
     _run_experiment(["amp"] + _SMOKE)
     out = capsys.readouterr().out
     assert "bf16_speedup" in out
 
 
+@pytest.mark.slow
 def test_experiment_gradsync_smoke(capsys, tmp_path):
     _run_experiment(["gradsync"] + _SMOKE
                     + ["--csv", str(tmp_path / "gs.csv")])
@@ -101,6 +108,7 @@ def test_experiment_gradsync_smoke(capsys, tmp_path):
     assert (tmp_path / "gs.csv").exists()
 
 
+@pytest.mark.slow
 def test_experiment_pipeline_smoke(capsys):
     _run_experiment(["pipeline"] + _SMOKE)
     out = capsys.readouterr().out
@@ -182,6 +190,7 @@ def test_plot_appended_csv_uses_latest_run(tmp_path):
     assert out.exists() and out.stat().st_size > 5000
 
 
+@pytest.mark.slow
 def test_experiment_gradsync_bert_smoke(capsys):
     """The BASELINE matrix's config 4 is 'BERT-base MLM seq-len 512
     (grad-sync profiling run)' — the gradsync driver must serve LM models,

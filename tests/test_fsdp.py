@@ -55,6 +55,7 @@ def _leaves_with_paths(tree):
             for path, leaf in jax.tree_util.tree_leaves_with_path(tree)]
 
 
+@pytest.mark.slow
 def test_fsdp_params_and_opt_state_actually_sharded(fsdp_mesh):
     """`--mesh fsdp=4` must place param AND optimizer-moment shards, not
     silently replicate (the round-1/2 advertised-but-absent gap)."""
@@ -102,6 +103,7 @@ def test_fsdp_matches_replicated_math(fsdp_mesh):
                                float(m_fsdp["correct"]), rtol=0)
 
 
+@pytest.mark.slow
 def test_fsdp_training_step_decreases_loss(fsdp_mesh):
     t, state = _trainer(fsdp_mesh, GPT2LMHead.partition_rules())
     batch = _batch(fsdp_mesh)
@@ -119,6 +121,7 @@ def test_fsdp_training_step_decreases_loss(fsdp_mesh):
     assert "fsdp" in flat, qkv.sharding
 
 
+@pytest.mark.slow
 def test_fsdp_times_tp_2d_layout(devices):
     """fsdp=2 x model=2 x data=2: 2-D parameter sharding + DP, one mesh."""
     mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2), devices=devices)
@@ -132,6 +135,7 @@ def test_fsdp_times_tp_2d_layout(devices):
     assert np.isfinite(float(m["loss_sum"]))
 
 
+@pytest.mark.slow
 def test_fsdp_checkpoint_roundtrip(fsdp_mesh, tmp_path):
     """Orbax save/restore of an FSDP-sharded TrainState: restored leaves must
     carry the template's fsdp sharding and identical values — the sharded

@@ -27,7 +27,8 @@ def test_tokenize_cli_writes_packed_layout(tmp_path):
     # the tokens ARE the file's bytes, in order
     np.testing.assert_array_equal(train[:64],
                                   np.frombuffer(raw[:64], np.uint8))
-    assert train.max() < 256
+    # byte path stores uint16 (tokenize.encode_bytes) with all ids < 256
+    assert train.dtype == np.uint16 and train.max() < 256
 
 
 @pytest.mark.slow

@@ -63,6 +63,7 @@ class TestVocabPaddingTP:
         return float(optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1].astype(jnp.float32), ids[:, 1:]).mean())
 
+    @pytest.mark.slow
     def test_padded_embedding_shards_over_model_and_loss_matches(self, devices):
         import math
 

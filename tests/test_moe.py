@@ -150,6 +150,7 @@ def test_moe_trains_expert_parallel(devices):
     assert np.abs(wi_after - wi_before).sum() > 0  # experts actually updated
 
 
+@pytest.mark.slow
 def test_moe_remat_trains(devices):
     """gpt2_moe with --remat: dense blocks checkpointed, MoE blocks (which
     sow the router aux loss) left plain — the step must still run and sow."""

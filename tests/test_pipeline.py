@@ -51,6 +51,7 @@ def test_pipeline_matches_sequential_forward(devices, layer_setup):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential_grad(devices, layer_setup):
     _, x, stacked, apply_layer = layer_setup
     mesh = build_mesh(MeshSpec(pipe=4, data=2), devices=devices)
@@ -101,6 +102,7 @@ def _lm_batch(mesh, n=8, seq=16, vocab=64):
     }, mesh)
 
 
+@pytest.mark.slow
 def test_pipelined_gpt2_matches_sequential_gpt2(devices):
     """Same weights -> same logits: the pipelined model restacked from a
     plain GPT2LMHead's params must reproduce its forward exactly (up to fp
@@ -132,6 +134,7 @@ def test_pipelined_gpt2_matches_sequential_gpt2(devices):
                                np.asarray(ref_logits), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pipelined_training_step_decreases_loss(devices):
     """A full TRAINING step through the pipeline: Trainer + AdamW + GPipe
     forward/backward; loss must decrease and stage params must stay sharded
@@ -166,6 +169,7 @@ def test_pipelined_training_step_decreases_loss(devices):
     assert int(state.step) == 8
 
 
+@pytest.mark.slow
 def test_pipelined_remat_matches_plain(devices):
     """jax.checkpoint inside pipeline stages changes memory, not math."""
     mesh = build_mesh(MeshSpec(pipe=2, data=4), devices=devices)

@@ -35,6 +35,7 @@ def test_matches_reference(devices, qkv, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_grads_match_reference(devices, qkv):
     q, k, v = qkv
     mesh = build_mesh(MeshSpec(data=2, seq=4), devices=devices)
