@@ -353,6 +353,9 @@ def _bench(args):
                       f"_b{args.batch_size}",
             "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
             "error": f"backend init failed: {e}",
+            # a wedged tunnel is environmental — the committed probe log
+            # makes the failure attributable (who held the claim, since when)
+            "chip_status_log": "CHIP_STATUS.md",
         }))
         return 1
 

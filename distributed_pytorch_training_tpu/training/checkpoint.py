@@ -80,6 +80,19 @@ class CheckpointManager:
         )
         return state, int(restored["epoch"]), int(restored["step_in_epoch"])
 
+    def latest_metadata(self) -> Optional[dict]:
+        """Structure/shape metadata of the latest checkpoint WITHOUT reading
+        array data (orbax item metadata). Lets callers diagnose a template
+        mismatch precisely — e.g. a TP-vocab-padded (50304, d) embedding
+        saved under a different --mesh than the resume run's."""
+        label = self._mgr.latest_step()
+        if label is None:
+            return None
+        try:
+            return self._mgr.item_metadata(label)
+        except Exception:
+            return None
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -71,3 +71,24 @@ def test_train_cli_lm_on_real_corpus(tmp_path, capsys):
     # byte-entropy starting point (ln 256 ~ 5.55 at init)
     assert float(rows[1][1]) < float(rows[0][1])
     assert float(rows[0][1]) < 6.0
+
+
+@pytest.mark.slow
+def test_resume_under_different_mesh_diagnoses_vocab_padding(tmp_path):
+    """Param shapes follow the TP layout (vocab padding = lcm(128, model
+    axis)); resuming under a different --mesh must fail with a message
+    naming the saved vs built vocab rows, not an opaque orbax error."""
+    import train
+
+    common = [
+        "--model", "gpt2_124m",
+        "--model-overrides", "depth=1,hidden_dim=32,num_heads=2,max_position=32",
+        "--synthetic", "--synthetic-size", "64", "--seq-len", "32",
+        "--epochs", "1", "--batch-size", "2", "--print-freq", "100",
+        "--seed", "0", "--output-dir", str(tmp_path / "out"),
+        "--checkpoint-dir", str(tmp_path / "ckpt"),
+    ]
+    train.main(common + ["--mesh", "data=4,model=2"])  # padded vocab 50304
+
+    with pytest.raises(RuntimeError, match="vocab rows"):
+        train.main(common + ["--mesh", "data=8", "--resume"])  # built 50257

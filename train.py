@@ -363,11 +363,30 @@ def _run(args, guard):
                 # Param SHAPES depend on the TP layout (vocab padding is
                 # lcm(128, model-axis)): resuming under a different --mesh
                 # builds a mismatched template and orbax fails opaquely.
+                # Diagnose precisely from the saved shape metadata.
+                hint = ("resume with the SAME --mesh (the vocab padding "
+                        "for TP follows the model axis)")
+                try:
+                    meta = ckpt.latest_metadata()
+                    saved_params = meta["params"] if meta else {}
+                    for emb_name in ("wte", "token_embedding"):
+                        if emb_name in saved_params:
+                            saved_rows = saved_params[emb_name][
+                                "embedding"].shape[0]
+                            have = getattr(model, "padded_vocab",
+                                           getattr(model, "vocab_size", "?"))
+                            if saved_rows != have:
+                                hint = (
+                                    f"the checkpoint's {emb_name} has "
+                                    f"{saved_rows} vocab rows but this run "
+                                    f"built {have} — pass --model-overrides "
+                                    f"pad_vocab_to_multiple_of=<m> (or the "
+                                    f"original --mesh) so the padded vocab "
+                                    f"matches {saved_rows}")
+                except Exception:
+                    pass  # metadata diagnosis is best-effort only
                 raise RuntimeError(
-                    "checkpoint restore failed — if the error below is a "
-                    "shape mismatch, resume with the SAME --mesh (the vocab "
-                    "padding for TP follows the model axis): " + str(e)
-                ) from e
+                    f"checkpoint restore failed — {hint}: {e}") from e
             if restored is not None:
                 state, start_epoch, start_step = restored
                 if start_step >= steps_per_epoch:  # stale steps_per_epoch
