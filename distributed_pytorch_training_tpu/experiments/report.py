@@ -78,7 +78,7 @@ def render_table(entry: dict) -> str:
             f"| {_label(cfg, headline_model)} "
             f"| {cfg.get('per_device_batch', '?')} "
             f"| {_rate(cfg)} "
-            f"| {mfu if mfu is not None else '—'}% |")
+            f"| {'—' if mfu is None else f'{mfu}%'} |")
     if entry.get("configs_skipped"):
         lines.append("")
         lines.append("(skipped under the bench deadline: "
