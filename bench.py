@@ -454,6 +454,9 @@ def _bench(args):
             # long-context (flash kernels) and expert-parallel coverage
             ("gpt2_124m", dict(per_device_batch=2, seq_len=4096, steps=10)),
             ("gpt2_moe", dict(per_device_batch=8, seq_len=1024, steps=10)),
+            # the BASELINE flagship architecture (config 5) at single-chip
+            # scale: ~4.3GB params+moments fp32, fits v5e HBM at b=2
+            ("gpt2_355m", dict(per_device_batch=2, seq_len=1024, steps=6)),
         ):
             if time_left() < 120:
                 skipped.append(name)
