@@ -53,6 +53,29 @@ HISTORY_PATH = Path(__file__).resolve().parent / \
     "distributed_pytorch_training_tpu" / "experiments" / "results" / \
     "bench_history.jsonl"
 
+# Non-headline configs of the BASELINE matrix: (label, model, est_s, kwargs).
+# Labels are stable names for --only selection and for bench_history rows;
+# est_s is the conservative wall-cost gate documented at the use site.
+EXTRA_CONFIGS = (
+    ("resnet50", "resnet50", 420,
+     dict(per_device_batch=128, image_hw=224, num_classes=1000, steps=10)),
+    ("vit_b16", "vit_b16", 420,
+     dict(per_device_batch=64, image_hw=224, num_classes=1000, steps=10)),
+    ("gpt2_124m", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10)),
+    ("bert_base", "bert_base", 400,
+     dict(per_device_batch=16, seq_len=512, steps=10)),
+    # long-context (flash kernels) and expert-parallel coverage
+    ("gpt2_124m_s4096", "gpt2_124m", 420,
+     dict(per_device_batch=2, seq_len=4096, steps=10)),
+    ("gpt2_moe", "gpt2_moe", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10)),
+    # the BASELINE flagship architecture (config 5) at single-chip scale:
+    # ~4.3GB params+moments fp32, fits v5e HBM at b=2
+    ("gpt2_355m", "gpt2_355m", 420,
+     dict(per_device_batch=2, seq_len=1024, steps=6)),
+)
+
 # Probe script run in a disposable subprocess: succeeds iff the backend can
 # actually enumerate devices. Lives out-of-process so a wedged tunnel (which
 # blocks jax.devices() in a C-level recv no signal handler can interrupt)
@@ -223,6 +246,13 @@ def _parse(argv):
     p.add_argument("--repeats", default=3, type=int)
     p.add_argument("--quick", action="store_true",
                    help="headline config only (skip gpt2/bert extras)")
+    p.add_argument("--only", default=None,
+                   help="comma-separated config labels to run, from "
+                        "{headline, fp32} plus the EXTRA_CONFIGS labels "
+                        "(e.g. --only resnet50,vit_b16). For chunked "
+                        "provenance runs that each finish well inside one "
+                        "deadline; every completed run still appends to "
+                        "bench_history.jsonl")
     p.add_argument("--deadline", default=840, type=int,
                    help="hard wall-clock limit (s); must sit INSIDE the "
                         "driver's own timeout so a hung backend costs an "
@@ -253,6 +283,8 @@ def main(argv=None):
            "--probe-timeout", str(args.probe_timeout)]
     if args.quick:
         cmd.append("--quick")
+    if args.only:
+        cmd += ["--only", args.only]
     def rc_for(line, fallback_rc):
         # A valid measured result that was flushed must count as success
         # even when the inner later crashed or was SIGTERMed; an inner
@@ -293,6 +325,25 @@ def main(argv=None):
         if salvaged is not None:
             _log(f"bench: deadline hit but a result JSON was already "
                  f"flushed — reporting it")
+            # A SIGTERMed inner usually never reached its own
+            # _record_history: append the salvaged measurement here so
+            # provenance survives a deadline (the r5 full-matrix run lost
+            # its history row this way before this branch existed). Guards:
+            # the test hooks must not pollute the committed log (the hang
+            # tests run this parent as a subprocess, out of monkeypatch
+            # reach), and an inner that DID record and then hung in PJRT
+            # teardown must not produce a duplicate row.
+            try:
+                d = json.loads(salvaged)
+                if "error" not in d \
+                        and not os.environ.get("DPT_BENCH_TEST_HANG") \
+                        and not os.environ.get("DPT_BENCH_TEST_WEDGE") \
+                        and not _history_has(d):
+                    d["salvaged_after_deadline"] = True
+                    _resolve_provisional_marker(d, args.only)
+                    _record_history(d)
+            except Exception:
+                pass
             print(salvaged)
             return rc_for(salvaged, 1)
         err = f"bench exceeded {args.deadline}s deadline (hung backend?)"
@@ -302,6 +353,57 @@ def main(argv=None):
         "error": err,
     }))
     return 1
+
+
+def _last_good() -> "dict | None":
+    """Most recent committed history row with a real on-chip number — cited
+    in the backend-init error JSON so a wedged tunnel (hours-long, twice
+    observed: CHIP_STATUS.md) doesn't erase the evidence trail."""
+    try:
+        rows = [json.loads(l) for l in
+                HISTORY_PATH.read_text().splitlines() if l.strip()]
+        for r in reversed(rows):
+            if r.get("value", 0) and "TPU" in str(r.get("chip", "")):
+                return {k: r.get(k) for k in
+                        ("timestamp", "metric", "value", "mfu_pct", "chip")}
+    except Exception:
+        pass
+    return None
+
+
+def _resolve_provisional_marker(d: dict, only_arg: "str | None") -> None:
+    """A salvaged provisional line carries a literal "<provisional>" in
+    configs_skipped (it is printed before the inner knows what it will get
+    to). History rows are provenance: replace the marker with the configs
+    that actually never ran — selected labels minus measured ones — so the
+    regenerated README never renders a placeholder as data."""
+    skipped = d.get("configs_skipped") or []
+    if "<provisional>" not in skipped:
+        return
+    sel = ({s.strip() for s in only_arg.split(",") if s.strip()}
+           if only_arg else {l for l, _, _, _ in EXTRA_CONFIGS})
+    measured = {c.get("label") for c in d.get("configs", [])
+                if c.get("label")}
+    missing = {s for s in skipped if s != "<provisional>"} \
+        | (sel - {"headline", "fp32"} - measured)
+    if (only_arg is None or "fp32" in sel) and \
+            not any(c.get("bf16") is False for c in d.get("configs", [])):
+        missing.add("fp32")
+    d["configs_skipped"] = sorted(missing)
+
+
+def _history_has(result: dict) -> bool:
+    """True iff the last history row is the same measurement (the inner
+    recorded it, flushed the JSON, then hung in teardown past the deadline).
+    Bookkeeping keys the two paths add differently are ignored."""
+    drop = ("timestamp", "salvaged_after_deadline")
+    try:
+        last = json.loads(
+            HISTORY_PATH.read_text().splitlines()[-1])
+        return {k: v for k, v in last.items() if k not in drop} == \
+            {k: v for k, v in result.items() if k not in drop}
+    except Exception:
+        return False
 
 
 def _record_history(result: dict) -> None:
@@ -340,6 +442,30 @@ def _bench(args):
                               "unit": "samples/sec/chip",
                               "vs_baseline": None}), flush=True)
         time.sleep(10_000)
+    # --only parsing happens before the backend is touched: an unknown label
+    # must fail loudly without ever claiming the chip.
+    only = None
+    if args.only:
+        only = {s.strip() for s in args.only.split(",") if s.strip()}
+        known = {"headline", "fp32"} | {l for l, _, _, _ in EXTRA_CONFIGS}
+        unknown = sorted(only - known)
+        if unknown:
+            print(json.dumps({
+                "metric": "bench_only_filter", "value": 0.0,
+                "unit": "samples/sec/chip", "vs_baseline": 0.0,
+                "error": f"unknown --only labels {unknown}; known: "
+                         f"{sorted(known)}"}))
+            return 1
+        if not only:
+            print(json.dumps({
+                "metric": "bench_only_filter", "value": 0.0,
+                "unit": "samples/sec/chip", "vs_baseline": 0.0,
+                "error": f"--only {args.only!r} selects nothing; known: "
+                         f"{sorted(known)}"}))
+            return 1
+        if "fp32" in only:
+            only.add("headline")  # vs_baseline is a ratio against headline
+
     try:
         # The init budget must leave the watchdog room to hear the error-
         # JSON: clamp it under the hard deadline regardless of flag values.
@@ -356,6 +482,9 @@ def _bench(args):
             # a wedged tunnel is environmental — the committed probe log
             # makes the failure attributable (who held the claim, since when)
             "chip_status_log": "CHIP_STATUS.md",
+            # ...and the last committed on-chip measurement still exists
+            # even when this invocation can't reach the chip
+            "last_good_committed_run": _last_good(),
         }))
         return 1
 
@@ -414,12 +543,13 @@ def _bench(args):
     # must degrade vs_baseline to null, not forfeit the headline number.
     err = None
     headline = fp32 = None
-    try:
-        headline = run("resnet18", per_device_batch=args.batch_size,
-                       steps=args.steps, bf16=True)
-    except Exception as e:
-        err = f"{type(e).__name__}: {e}"
-        _log("bench: headline config failed:\n" + traceback.format_exc())
+    if only is None or "headline" in only:
+        try:
+            headline = run("resnet18", per_device_batch=args.batch_size,
+                           steps=args.steps, bf16=True)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            _log("bench: headline config failed:\n" + traceback.format_exc())
     if headline is not None:
         # Provisional line: a config can overrun the soft-deadline check
         # (compile + the MeasurementError long-window retry are unbounded),
@@ -428,7 +558,17 @@ def _bench(args):
         # salvages the LAST flushed JSON line.
         print(json.dumps(result_dict(headline, None, [], ["<provisional>"])),
               flush=True)
-    if headline is not None and time_left() > 120:
+    extras = []
+    skipped = []
+
+    # fp32 arm cost estimate: measured 150s on the tunneled v5e (no extra
+    # compile of the data path, but HIGHEST-precision matmuls are ~4x the
+    # step time); 300s keeps the same never-SIGTERMed margin as the extras.
+    # When the arm is wanted but the budget is gone, that is recorded in
+    # configs_skipped — an explicitly requested --only fp32 must not vanish
+    # silently from an rc=0 result.
+    want_fp32 = headline is not None and (only is None or "fp32" in only)
+    if want_fp32 and time_left() > 300:
         try:
             fp32 = run("resnet18", per_device_batch=args.batch_size,
                        steps=args.steps, bf16=False)
@@ -437,39 +577,94 @@ def _bench(args):
         except Exception:
             _log("bench: fp32 baseline arm failed (vs_baseline -> null):\n"
                  + traceback.format_exc())
+    elif want_fp32:
+        skipped.append("fp32")
+        _log("bench: skipped fp32 arm — remaining soft budget "
+             f"({time_left():.0f}s) is under its 300s estimate")
 
-    extras = []
-    skipped = []
-    if headline is not None and not args.quick:
+    def chunk_result():
+        """Result line for a chunked --only run without the headline: report
+        the first selected config; every config is in `configs`."""
+        first = extras[0]
+        return {
+            "metric": f"{first['label']}_train_throughput_bf16",
+            "value": first["samples_per_sec_chip"],
+            "unit": "samples/sec/chip",
+            "vs_baseline": None,
+            "n_chips": n_chips,
+            "chip": devices[0].device_kind,
+            "mfu_pct": first["mfu_pct"],
+            "only": sorted(only),
+            "configs": extras,
+            "configs_skipped": skipped,
+            "bench_seconds": round(time.monotonic() - t_start, 1),
+        }
+
+    # An explicit --only selection overrides --quick: a requested config must
+    # run (or fail loudly), never be silently dropped by an unrelated flag.
+    if args.quick and only is not None:
+        _log("bench: --only given; ignoring --quick for the selected labels")
+    if (headline is not None or only) and (not args.quick or only is not None):
         # The rest of the BASELINE matrix, single-chip (BASELINE.json:9-12):
         # ResNet-50 + ViT-B/16 on ImageNet shapes, GPT-2 124M causal LM,
-        # BERT-base MLM @ 512.
-        for name, kw in (
-            ("resnet50", dict(per_device_batch=128, image_hw=224,
-                              num_classes=1000, steps=10)),
-            ("vit_b16", dict(per_device_batch=64, image_hw=224,
-                             num_classes=1000, steps=10)),
-            ("gpt2_124m", dict(per_device_batch=8, seq_len=1024, steps=10)),
-            ("bert_base", dict(per_device_batch=16, seq_len=512, steps=10)),
-            # long-context (flash kernels) and expert-parallel coverage
-            ("gpt2_124m", dict(per_device_batch=2, seq_len=4096, steps=10)),
-            ("gpt2_moe", dict(per_device_batch=8, seq_len=1024, steps=10)),
-            # the BASELINE flagship architecture (config 5) at single-chip
-            # scale: ~4.3GB params+moments fp32, fits v5e HBM at b=2
-            ("gpt2_355m", dict(per_device_batch=2, seq_len=1024, steps=6)),
-        ):
-            if time_left() < 120:
-                skipped.append(name)
+        # BERT-base MLM @ 512. Each entry is (label, model, est_s, kwargs):
+        # est_s is a conservative wall-cost estimate on the tunneled v5e
+        # (compile dominates; measured 2026-07-31: headline b4096 took 226s,
+        # its fp32 arm 150s, and resnet50@224 was still compiling at +370s
+        # when the watchdog fired). A config only STARTS when the remaining
+        # soft budget covers its estimate: the inner must always finish on
+        # its own and release the chip by exiting — a watchdog SIGTERM of a
+        # chip-holding process wedged the tunnel for hours, twice
+        # (CHIP_STATUS.md). Under the default 840s driver deadline the
+        # estimates deliberately leave no room for extras after the
+        # headline+fp32 pair; full-matrix provenance comes from chunked
+        # `--only` runs committed to bench_history.jsonl.
+        for label, name, est_s, kw in EXTRA_CONFIGS:
+            if only is not None and label not in only:
+                continue
+            if time_left() < est_s:
+                skipped.append(label)
                 continue
             try:
-                extras.append(run(name, bf16=True, **kw))
+                r = run(name, bf16=True, **kw)
+                r["label"] = label
+                extras.append(r)
+                # Flush a provisional line after EVERY completed config so a
+                # deadline SIGTERM or teardown hang can't lose already-
+                # measured work (the parent salvages the last flushed JSON
+                # line) — in chunked runs and full-matrix runs alike.
+                if headline is None:
+                    print(json.dumps(chunk_result()), flush=True)
+                else:
+                    print(json.dumps(result_dict(
+                        headline, fp32, extras,
+                        skipped + ["<provisional>"])), flush=True)
             except Exception:
-                _log(f"bench: extra config {name} failed (continuing):\n"
+                _log(f"bench: extra config {label} failed (continuing):\n"
                      + traceback.format_exc())
         if skipped:
-            _log(f"bench: skipped {skipped} — soft deadline "
-                 f"({args.deadline}s watchdog) nearly reached; the headline "
-                 "JSON must land before the parent SIGTERMs us")
+            _log(f"bench: skipped {skipped} — remaining soft budget "
+                 f"({time_left():.0f}s of the {args.deadline}s watchdog) is "
+                 "under their cost estimates; exiting cleanly instead of "
+                 "risking a SIGTERM while holding the chip")
+
+    if headline is None and extras:
+        result = chunk_result()
+        _record_history(result)
+        print(json.dumps(result), flush=True)
+        return 0
+
+    if headline is None and only:
+        # A chunked run whose every selected config failed or was skipped:
+        # name the requested labels, don't blame the never-run headline.
+        print(json.dumps({
+            "metric": "bench_only_chunk", "value": 0.0,
+            "unit": "samples/sec/chip", "vs_baseline": 0.0,
+            "error": f"no selected config produced a measurement "
+                     f"(requested {sorted(only)}, skipped {skipped})"
+                     + (f"; headline failed: {err}" if err else ""),
+        }), flush=True)
+        return 1
 
     if headline is None:
         print(json.dumps({
@@ -477,12 +672,13 @@ def _bench(args):
                       f"_b{args.batch_size}",
             "value": 0.0, "unit": "samples/sec/chip", "vs_baseline": 0.0,
             "error": err or "unknown",
-        }))
+            "configs_skipped": skipped,
+        }), flush=True)
         return 1
 
     result = result_dict(headline, fp32, extras, skipped)
     _record_history(result)
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
     return 0
 
 

@@ -8,11 +8,15 @@ missing #2; the reference's README promises result tables it never fills,
 /root/reference/README.md:25-35):
 
     python -m distributed_pytorch_training_tpu.experiments.report
+    python -m distributed_pytorch_training_tpu.experiments.report --latest
     python -m distributed_pytorch_training_tpu.experiments.report --all
 
-The default prints the table for the LATEST history entry; --all lists one
-summary line per entry (chip, timestamp, headline) so regressions stay
-visible.
+The default MERGES history entries: the full config matrix is measured in
+chunked ``bench.py --only <labels>`` runs (each sized to finish inside one
+driver deadline — see bench.py EXTRA_CONFIGS), so one entry rarely holds
+every row. The merged view takes, per config, the newest measurement on the
+newest chip kind, with a per-row timestamp. --latest prints the last entry
+alone; --all lists one summary line per entry so regressions stay visible.
 """
 
 from __future__ import annotations
@@ -87,6 +91,64 @@ def render_table(entry: dict) -> str:
     return "\n".join(lines)
 
 
+def _cfg_key(cfg: dict) -> str:
+    """Stable identity of one measured config across history entries."""
+    return cfg.get("label") or "_".join(str(x) for x in (
+        cfg.get("model"), f"b{cfg.get('per_device_batch')}",
+        f"s{cfg.get('seq_len')}" if cfg.get("seq_len") else "",
+        "bf16" if cfg.get("bf16") else "fp32") if x)
+
+
+def merge_entries(entries: List[dict]):
+    """Newest measurement per config on the newest measuring chip kind.
+
+    Chunked ``--only`` runs each contribute 1-2 configs; the merged view is
+    the full-matrix table the README carries. Returns (chip, vs_baseline,
+    rows) where rows is ``[(cfg, source_entry), ...]`` in first-seen order.
+    """
+    chip = next((e.get("chip") for e in reversed(entries)
+                 if e.get("configs")), None)
+    rows: dict = {}
+    vs = None
+    for e in entries:
+        if e.get("chip") != chip:
+            continue
+        for cfg in e.get("configs", []):
+            rows[_cfg_key(cfg)] = (cfg, e)
+        if e.get("vs_baseline") is not None:
+            vs = e["vs_baseline"]
+    return chip, vs, list(rows.values())
+
+
+def render_merged(entries: List[dict]) -> str:
+    chip, vs, rows = merge_entries(entries)
+    headline_model = "resnet18"
+    lines = [
+        f"Full matrix, merged from {len(entries)} committed history "
+        f"entr{'y' if len(entries) == 1 else 'ies'} on {chip} "
+        f"(newest measurement per config; `vs_baseline` "
+        f"bf16-over-true-fp32 = {vs if vs is not None else 'n/a'}):",
+        "",
+        "| config | per-chip batch | samples/s/chip | MFU | measured |",
+        "|---|---|---|---|---|",
+    ]
+    for cfg, e in rows:
+        mfu = cfg.get("mfu_pct")
+        lines.append(
+            f"| {_label(cfg, headline_model)} "
+            f"| {cfg.get('per_device_batch', '?')} "
+            f"| {_rate(cfg)} "
+            f"| {'—' if mfu is None else f'{mfu}%'} "
+            f"| {e.get('timestamp', '?')} |")
+    measured = {_cfg_key(cfg) for cfg, _ in rows}
+    never = [k for e in entries if e.get("chip") == chip
+             for k in e.get("configs_skipped", []) if k not in measured]
+    if never:
+        lines += ["", "(still unmeasured on this chip: "
+                  + ", ".join(sorted(set(never))) + ")"]
+    return "\n".join(lines)
+
+
 def load_history(path: Path) -> List[dict]:
     if not path.exists():
         return []
@@ -110,7 +172,10 @@ def main(argv=None) -> int:
     p.add_argument("--history", default=str(HISTORY))
     p.add_argument("--all", action="store_true",
                    help="one summary line per history entry instead of the "
-                        "latest entry's full table")
+                        "merged full-matrix table")
+    p.add_argument("--latest", action="store_true",
+                   help="table for the latest entry alone (no merging "
+                        "across chunked runs)")
     args = p.parse_args(argv)
 
     entries = load_history(Path(args.history))
@@ -126,7 +191,10 @@ def main(argv=None) -> int:
                   f"{e.get('metric', '?')}: {e.get('value')} "
                   f"{e.get('unit', '')} (vs_baseline {e.get('vs_baseline')})")
         return 0
-    print(render_table(entries[-1]))
+    if args.latest:
+        print(render_table(entries[-1]))
+        return 0
+    print(render_merged(entries))
     return 0
 
 

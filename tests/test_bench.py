@@ -54,6 +54,9 @@ def test_watchdog_salvages_flushed_result_json_on_deadline():
     the pipe and reports the last JSON line with rc=0."""
     # deadline 15 not 5: the inner needs interpreter startup time to reach
     # the flush under load, and the test's point is the salvage, not speed
+    import bench
+    before = bench.HISTORY_PATH.read_text() \
+        if bench.HISTORY_PATH.exists() else ""
     proc, lines = _run_bench(
         ["--deadline", "15", "--quick"],
         env_extra={"DPT_BENCH_TEST_HANG": "after-json"}, timeout=120)
@@ -62,6 +65,12 @@ def test_watchdog_salvages_flushed_result_json_on_deadline():
     result = json.loads(lines[0])
     assert result["value"] == 42.0
     assert "error" not in result
+    # The parent's salvage-append runs in a subprocess, beyond monkeypatch
+    # reach: the committed provenance log must not gain the 42.0 test row
+    # (it did once — a junk row had to be stripped from bench_history.jsonl).
+    after = bench.HISTORY_PATH.read_text() \
+        if bench.HISTORY_PATH.exists() else ""
+    assert after == before
 
 
 @pytest.mark.slow
