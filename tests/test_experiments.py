@@ -286,3 +286,93 @@ class TestBenchReport:
 
         assert main(["--history", str(tmp_path / "nope.jsonl")]) == 1
         assert "no history" in capsys.readouterr().err
+
+    def test_merged_view_joins_chunked_runs(self, tmp_path, capsys):
+        """The full matrix accumulates through `bench.py --only` chunk runs;
+        the default report view must join them — newest per config, CPU
+        mechanism-validation rows excluded once a TPU row exists."""
+        import json
+
+        from distributed_pytorch_training_tpu.experiments.report import main
+
+        cpu = {"metric": "m", "value": 1.0, "chip": "cpu",
+               "timestamp": "2026-07-28T00:00:00Z",
+               "configs": [{"model": "resnet18", "bf16": True,
+                            "per_device_batch": 256,
+                            "samples_per_sec_chip": 1.0, "mfu_pct": None}]}
+        chunk = {"metric": "gpt2_124m_train_throughput_bf16", "value": 100.0,
+                 "chip": "TPU v5 lite", "timestamp": "2026-07-31T02:00:00Z",
+                 "only": ["gpt2_124m"],
+                 "configs": [{"model": "gpt2_124m", "label": "gpt2_124m",
+                              "bf16": True, "per_device_batch": 8,
+                              "seq_len": 1024, "samples_per_sec_chip": 100.0,
+                              "mfu_pct": 45.0}],
+                 "configs_skipped": []}
+        stale = dict(chunk, timestamp="2026-07-30T00:00:00Z")
+        stale["configs"] = [dict(chunk["configs"][0],
+                                 samples_per_sec_chip=90.0)]
+        hist = tmp_path / "h.jsonl"
+        hist.write_text("\n".join(json.dumps(e) for e in
+                                  (cpu, stale, self.ENTRY, chunk)) + "\n")
+        assert main(["--history", str(hist)]) == 0
+        out = capsys.readouterr().out
+        assert "ResNet-18 / CIFAR-10 (headline)" in out   # from ENTRY
+        assert "| 100 " in out and "| 90 " not in out     # newest chunk won
+        assert "2026-07-31T02:00:00Z" in out              # per-row source
+        assert "| 256 " not in out                        # cpu entry excluded
+        assert "bert_base" in out                         # still unmeasured
+
+    def test_latest_flag_keeps_single_entry_view(self, tmp_path, capsys):
+        import json
+
+        from distributed_pytorch_training_tpu.experiments.report import main
+
+        hist = tmp_path / "h.jsonl"
+        hist.write_text(json.dumps(self.ENTRY) + "\n")
+        assert main(["--history", str(hist), "--latest"]) == 0
+        assert "Measured on 1x TPU v5 lite" in capsys.readouterr().out
+
+
+class TestBenchHistoryHelpers:
+    """The salvage path's provenance hygiene: marker resolution and
+    teardown-hang dedupe (bench.py watchdog)."""
+
+    def test_provisional_marker_resolves_to_unmeasured_labels(self):
+        import bench
+
+        d = {"configs": [{"model": "resnet18", "bf16": True},
+                         {"model": "resnet18", "bf16": False}],
+             "configs_skipped": ["<provisional>"]}
+        bench._resolve_provisional_marker(d, None)
+        assert "<provisional>" not in d["configs_skipped"]
+        assert set(d["configs_skipped"]) == \
+            {l for l, _, _, _ in bench.EXTRA_CONFIGS}
+
+    def test_provisional_marker_respects_only_selection(self):
+        import bench
+
+        d = {"configs": [{"model": "resnet18", "bf16": True}],
+             "configs_skipped": ["<provisional>"]}
+        bench._resolve_provisional_marker(d, "headline,fp32,resnet50")
+        # fp32 arm never ran (no bf16=False config) and resnet50 never ran
+        assert set(d["configs_skipped"]) == {"fp32", "resnet50"}
+
+    def test_marker_resolution_keeps_real_lists_untouched(self):
+        import bench
+
+        d = {"configs": [], "configs_skipped": ["resnet50"]}
+        bench._resolve_provisional_marker(d, None)
+        assert d["configs_skipped"] == ["resnet50"]
+
+    def test_history_dedupe_ignores_bookkeeping_keys(self, tmp_path,
+                                                     monkeypatch):
+        import json
+
+        import bench
+
+        row = {"metric": "m", "value": 1.0, "configs": []}
+        hist = tmp_path / "h.jsonl"
+        hist.write_text(json.dumps(dict(row, timestamp="t1")) + "\n")
+        monkeypatch.setattr(bench, "HISTORY_PATH", hist)
+        assert bench._history_has(dict(row, salvaged_after_deadline=True))
+        assert not bench._history_has(dict(row, value=2.0))
