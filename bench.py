@@ -100,6 +100,57 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _tunnel_status() -> "str | None":
+    """Liveness of the tunneled backend's local relay ports, if any.
+
+    On the tunneled single-chip environment, device RPCs and remote
+    compilation ride localhost relay ports (8082/8083/...); a dead relay is
+    indistinguishable from a "wedged chip" at the jax.devices() level (the
+    client sleep-retries UNAVAILABLE for tens of minutes — observed live,
+    CHIP_STATUS.md 2026-07-31 03:59: `/remote_compile: Connection refused`
+    after a 40-minute retry loop). A 200ms TCP connect distinguishes the two
+    failure classes. A dead relay and a machine that never had one look the
+    same from here, so the all-closed/unconfigured case returns a string
+    that says so explicitly; callers additionally gate the log note on
+    hang-type failures (the dead-relay signature) so deterministic errors
+    like an ImportError never carry a relay hint. Returns None only when
+    DPT_RELAY_PORTS is set but contains no usable port numbers.
+    """
+    import socket
+
+    ports = [p.strip() for p in
+             os.environ.get("DPT_RELAY_PORTS", "8082,8083").split(",")
+             if p.strip().isdigit()]
+    if not ports:
+        return None
+    status = {}
+    for p in ports:
+        try:
+            with socket.create_connection(("127.0.0.1", int(p)),
+                                          timeout=0.2):
+                status[p] = "listening"
+        except Exception:
+            status[p] = "closed"
+    if all(v == "closed" for v in status.values()):
+        if "DPT_RELAY_PORTS" in os.environ:
+            return "relay tunnel DOWN (all relay ports closed; no " \
+                "client-side remedy — the outer harness must respawn it)"
+        return "no local relay ports listening (not a tunneled " \
+            "environment, or the relay tunnel is dead — a probe that " \
+            "hangs in UNAVAILABLE retries means the latter)"
+    if any(v == "closed" for v in status.values()):
+        closed = [p for p, v in status.items() if v == "closed"]
+        return f"relay tunnel PARTIALLY down (ports {closed} closed — " \
+            "remote compilation will fail with UNAVAILABLE)"
+    confident = "DPT_RELAY_PORTS" in os.environ
+    return "relay ports listening (tunnel up; a hang past this point is a " \
+        "stuck server-side grant, not a dead relay)" if confident else \
+        "default relay ports (8082/8083) have listeners — IF this machine " \
+        "is the tunneled environment the tunnel is up and a hang is a " \
+        "stuck server-side grant; set DPT_RELAY_PORTS to make this check " \
+        "authoritative"
+
+
 def _stop_gently(proc: subprocess.Popen, grace_s: float = 15.0,
                  group: bool = False) -> bool:
     """SIGTERM + grace, never SIGKILL: an abruptly killed process that holds
@@ -188,6 +239,10 @@ def init_backend_with_retry(init_budget_s: float = 300.0,
                  f"{detail}")
             break
         _log(f"bench: backend probe {attempt} failed ({took:.1f}s): {detail}")
+        if "hung" in detail or "UNAVAILABLE" in detail:
+            tunnel = _tunnel_status()
+            if tunnel:
+                _log(f"bench: note: {tunnel}")
         if orphaned:
             # An un-reapable probe may still hold the chip claim; more
             # probes can only fail against it. Fail fast instead of
@@ -482,6 +537,7 @@ def _bench(args):
             # a wedged tunnel is environmental — the committed probe log
             # makes the failure attributable (who held the claim, since when)
             "chip_status_log": "CHIP_STATUS.md",
+            "tunnel_status": _tunnel_status(),
             # ...and the last committed on-chip measurement still exists
             # even when this invocation can't reach the chip
             "last_good_committed_run": _last_good(),
