@@ -216,11 +216,6 @@ def init_backend_with_retry(init_budget_s: float = 300.0,
     from distributed_pytorch_training_tpu.runtime import honor_platform_env
 
     honor_platform_env()  # JAX_PLATFORMS=cpu functional runs work as expected
-    try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
 
     deadline = time.monotonic() + init_budget_s
     attempt, last, same_fast_failures = 0, "no probe ran", 0
@@ -286,6 +281,16 @@ def init_backend_with_retry(init_budget_s: float = 300.0,
             time.sleep(2.0)
     _log(f"bench: backend up: {len(devices)}x {devices[0].device_kind} "
          f"[{devices[0].platform}]")
+    # Now that the backend is provably up, point the persistent compile
+    # cache at the repo-local dir (survives the host's /tmp-wiping reboots).
+    # Self-gating on the RESOLVED backend: a silent fallback to XLA:CPU must
+    # never get a persistent cache (unsafe reloads — runtime.dist docstring).
+    from distributed_pytorch_training_tpu.runtime import (
+        enable_persistent_compile_cache,
+    )
+    if enable_persistent_compile_cache(
+            Path(__file__).resolve().parent / ".jax_cache"):
+        _log("bench: persistent compile cache at .jax_cache/")
     return jax, devices
 
 

@@ -3,6 +3,7 @@
 from .dist import (  # noqa: F401
     DistContext,
     cleanup_distributed,
+    enable_persistent_compile_cache,
     honor_platform_env,
     is_distributed,
     per_process_seed,

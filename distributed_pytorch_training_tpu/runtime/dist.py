@@ -51,6 +51,33 @@ def honor_platform_env() -> None:
             pass
 
 
+def enable_persistent_compile_cache(cache_dir) -> bool:
+    """Point XLA's persistent compile cache at ``cache_dir`` — accelerator
+    backends only. Returns True iff enabled.
+
+    Gated on the RESOLVED backend, not env vars: an accelerator-init failure
+    can silently fall back to XLA:CPU, whose persistent-cache reloads are
+    unsafe here — AOT entries record pseudo machine features
+    (+prefer-no-scatter/gather) that fail the feature match on reload, and
+    the mismatch-loaded executables desynchronized an 8-device collective
+    rendezvous into a fatal abort (observed 2026-07-31 on the virtual CPU
+    mesh: ``cpu_aot_loader.cc`` mismatch warnings, then ``rendezvous.cc``
+    termination). Call only when backend init is acceptable (touching
+    ``jax.default_backend()`` brings the backend up — on a wedged tunnel
+    that can block, so callers probe first; see bench.py).
+    """
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+        # dir LAST: the cache only activates once the dir is set, so a
+        # failure in either update leaves it off and the False is honest
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        return True
+    except Exception:
+        return False
+
+
 @dataclasses.dataclass(frozen=True)
 class DistContext:
     """What `setup_distributed` returns — the TPU analogue of the reference's

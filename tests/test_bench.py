@@ -159,5 +159,11 @@ def test_tunnel_status_classifies_relay_liveness(monkeypatch):
         srv.close()
 
     # all configured ports closed -> the no-client-side-remedy message
-    monkeypatch.setenv("DPT_RELAY_PORTS", str(port))
-    assert "DOWN" in bench._tunnel_status()
+    # (bound-but-not-listening holds the port so nothing can race onto it)
+    down = socket.socket()
+    down.bind(("127.0.0.1", 0))
+    try:
+        monkeypatch.setenv("DPT_RELAY_PORTS", str(down.getsockname()[1]))
+        assert "DOWN" in bench._tunnel_status()
+    finally:
+        down.close()

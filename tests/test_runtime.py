@@ -47,3 +47,20 @@ def test_setup_distributed_single_process_context():
     assert ctx.process_index == 0
     assert ctx.process_count == 1
     assert ctx.is_main
+
+
+def test_persistent_compile_cache_refuses_cpu_backend(tmp_path):
+    """XLA:CPU persistent-cache reloads are unsafe (AOT pseudo-feature
+    mismatch desynchronized a collective rendezvous into a fatal abort —
+    runtime.dist.enable_persistent_compile_cache docstring). On the CPU
+    test backend the helper must refuse and leave the config untouched."""
+    import jax
+
+    from distributed_pytorch_training_tpu.runtime import (
+        enable_persistent_compile_cache,
+    )
+
+    before = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_compile_cache(tmp_path / "cache") is False
+    assert jax.config.jax_compilation_cache_dir == before
+    assert not (tmp_path / "cache").exists()
