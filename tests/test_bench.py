@@ -167,3 +167,46 @@ def test_tunnel_status_classifies_relay_liveness(monkeypatch):
         assert "DOWN" in bench._tunnel_status()
     finally:
         down.close()
+
+
+def test_empirical_wall_gate_uses_history_only_when_cache_primed(
+        tmp_path, monkeypatch):
+    """The static per-config cost estimates are sized for COLD compiles; a
+    primed compile cache plus a committed measured wall time for the same
+    label on the same chip must shrink the reservation (never grow it), so
+    the default-deadline driver run can fit the full matrix."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text(json.dumps({
+        "chip": "TPU v5 lite",
+        "configs": [{"label": "gpt2_124m", "wall_s": 80.0},
+                    {"label": "resnet50", "wall_s": 600.0},
+                    {"model": "resnet18", "bf16": True,
+                     "per_device_batch": 4096, "wall_s": 226.0}],
+    }) + "\n" + json.dumps({
+        "chip": "cpu",  # other-chip rows must not leak into the gate
+        "configs": [{"label": "bert_base", "wall_s": 1.0}],
+    }) + "\n")
+    monkeypatch.setattr(bench, "HISTORY_PATH", hist)
+
+    walls = bench._measured_walls("TPU v5 lite")
+    assert walls == {"gpt2_124m": 80.0, "resnet50": 600.0}
+
+    # the headline (label-less resnet18 bf16 row) is the warmth reference
+    assert bench._headline_wall("TPU v5 lite", 4096) == 226.0
+    assert bench._headline_wall("TPU v5 lite", 128) is None
+
+    # a truncated line mid-log must not drop the rows after it
+    hist.write_text(hist.read_text() + '{"chip": "TPU v5 l\n' + json.dumps(
+        {"chip": "TPU v5 lite",
+         "configs": [{"label": "bert_base", "wall_s": 70.0}]}) + "\n")
+    assert bench._measured_walls("TPU v5 lite")["bert_base"] == 70.0
+
+    # primed + measured -> 1.5x + 60, capped by the static estimate
+    assert bench._est_for("gpt2_124m", 400, walls, True) == 180.0
+    assert bench._est_for("resnet50", 420, walls, True) == 420  # cap holds
+    # unprimed cache or unmeasured label -> static estimate untouched
+    assert bench._est_for("gpt2_124m", 400, walls, False) == 400
+    assert bench._est_for("bert_base", 400, walls, True) == 400
