@@ -457,7 +457,7 @@ def _history_has(result: dict) -> bool:
     """True iff the last history row is the same measurement (the inner
     recorded it, flushed the JSON, then hung in teardown past the deadline).
     Bookkeeping keys the two paths add differently are ignored."""
-    drop = ("timestamp", "salvaged_after_deadline")
+    drop = ("timestamp", "salvaged_after_deadline", "code_fingerprint")
     try:
         last = json.loads(
             HISTORY_PATH.read_text().splitlines()[-1])
@@ -467,9 +467,46 @@ def _history_has(result: dict) -> bool:
         return False
 
 
-def _history_rows(chip_kind: str):
+_FINGERPRINT_CACHE = None
+
+
+def _code_fingerprint() -> str:
+    """Hash of everything that can re-key the persistent compile cache or
+    change a config's cost: the package sources, bench.py itself (its
+    EXTRA_CONFIGS kwargs define what each label measures), and the JAX
+    version. History rows record it; the warm gate only trusts walls from
+    rows whose fingerprint matches the running code, so ANY source edit —
+    one model file, one kwargs bump — silently reverts to the cold static
+    estimates instead of under-reserving a cold compile (the chip-wedging
+    watchdog-SIGTERM scenario)."""
+    global _FINGERPRINT_CACHE
+    if _FINGERPRINT_CACHE is not None:
+        return _FINGERPRINT_CACHE
+    import hashlib
+    h = hashlib.sha256()
+    try:
+        import jax
+        h.update(jax.__version__.encode())
+    except Exception:
+        pass
+    root = Path(__file__).resolve().parent
+    files = sorted((root / "distributed_pytorch_training_tpu").rglob("*.py"))
+    for f in [Path(__file__)] + files:
+        try:
+            h.update(str(f.relative_to(root)).encode())
+            h.update(f.read_bytes())
+        except Exception:
+            h.update(b"<unreadable>")
+    _FINGERPRINT_CACHE = h.hexdigest()[:16]
+    return _FINGERPRINT_CACHE
+
+
+def _history_rows(chip_kind: str, fingerprint: "str | None" = None):
     """Parsed history rows for one chip kind; a malformed line (truncated
-    append) skips that line only, never the rows after it."""
+    append) skips that line only, never the rows after it. With
+    ``fingerprint``, only rows recorded by that exact code state are
+    returned (the warm gate must never trust walls measured by different
+    code — see _code_fingerprint)."""
     rows = []
     try:
         lines = HISTORY_PATH.read_text().splitlines()
@@ -482,16 +519,20 @@ def _history_rows(chip_kind: str):
             row = json.loads(line)
         except Exception:
             continue
-        if row.get("chip") == chip_kind:
-            rows.append(row)
+        if row.get("chip") != chip_kind:
+            continue
+        if fingerprint is not None and \
+                row.get("code_fingerprint") != fingerprint:
+            continue
+        rows.append(row)
     return rows
 
 
-def _measured_walls(chip_kind: str) -> dict:
+def _measured_walls(chip_kind: str, fingerprint: "str | None" = None) -> dict:
     """{label: wall_s} of the most recent completed measurement per extra
-    config on this chip kind, from the committed history."""
+    config on this chip kind (and code state), from the committed history."""
     walls = {}
-    for row in _history_rows(chip_kind):
+    for row in _history_rows(chip_kind, fingerprint):
         for c in row.get("configs", []):
             if c.get("label") and c.get("wall_s"):
                 walls[c["label"]] = c["wall_s"]
@@ -499,9 +540,18 @@ def _measured_walls(chip_kind: str) -> dict:
 
 
 def _headline_wall(chip_kind: str, per_device_batch: int):
-    """Most recent committed wall_s of the headline config (resnet18 bf16 at
-    this exact batch) on this chip kind — the reference point that lets a
-    run PROVE its compile cache is hot (see _est_for)."""
+    """COLD-compile reference wall for the headline config (resnet18 bf16 at
+    this exact batch) on this chip kind, from the committed history: the MAX
+    committed wall — cold walls strictly dominate warm ones, and the newest
+    row may itself be a warm rerun (a last-row reference would then make
+    warmth unprovable forever). Deliberately CROSS-fingerprint, unlike the
+    extras' walls: a cold compile's magnitude is a property of chip+model,
+    not of the exact code state, and a generation whose first headline ran
+    warm (comment-only edit, cache still keyed) would otherwise have only
+    warm walls on record — making warmth unprovable for that generation.
+    Capped at 400s so one pathological committed run (long-window retries)
+    cannot inflate the reference until a genuinely cold run (~226s observed)
+    false-positives as warm."""
     wall = None
     for row in _history_rows(chip_kind):
         for c in row.get("configs", []):
@@ -509,8 +559,8 @@ def _headline_wall(chip_kind: str, per_device_batch: int):
                     and not c.get("label")
                     and c.get("per_device_batch") == per_device_batch
                     and c.get("wall_s")):
-                wall = c["wall_s"]
-    return wall
+                wall = max(wall or 0.0, c["wall_s"])
+    return min(wall, 400.0) if wall else None
 
 
 def _est_for(label: str, static_est_s: float, walls: dict,
@@ -540,6 +590,7 @@ def _record_history(result: dict) -> None:
         HISTORY_PATH.parent.mkdir(parents=True, exist_ok=True)
         entry = dict(result)
         entry["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        entry["code_fingerprint"] = _code_fingerprint()
         with open(HISTORY_PATH, "a") as f:
             f.write(json.dumps(entry) + "\n")
         _log(f"bench: appended result to {HISTORY_PATH}")
@@ -754,11 +805,12 @@ def _bench(args):
         # headline ran first, so a headline wall under half its committed
         # historical wall means its compile hit the cache — and the extras'
         # entries live in the same cache generation.
+        fp = _code_fingerprint()
         hist_wall = _headline_wall(devices[0].device_kind, args.batch_size)
         warm_proven = bool(
             cache_enabled and headline is not None and hist_wall
             and headline.get("wall_s", hist_wall) < 0.5 * hist_wall)
-        walls = _measured_walls(devices[0].device_kind)
+        walls = _measured_walls(devices[0].device_kind, fingerprint=fp)
         if warm_proven and walls:
             _log(f"bench: cache warmth proven (headline "
                  f"{headline['wall_s']:.0f}s vs historical {hist_wall:.0f}s);"

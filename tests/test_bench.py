@@ -198,6 +198,14 @@ def test_empirical_wall_gate_uses_history_only_when_cache_primed(
     assert bench._headline_wall("TPU v5 lite", 4096) == 226.0
     assert bench._headline_wall("TPU v5 lite", 128) is None
 
+    # the reference is the MAX committed wall (a newer warm rerun must not
+    # lower it into unprovability), capped at 400s against outliers
+    extra = [json.dumps({"chip": "TPU v5 lite", "configs": [
+        {"model": "resnet18", "bf16": True,
+         "per_device_batch": 4096, "wall_s": w}]}) for w in (61.0, 999.0)]
+    hist.write_text(hist.read_text() + "\n".join(extra) + "\n")
+    assert bench._headline_wall("TPU v5 lite", 4096) == 400.0
+
     # a truncated line mid-log must not drop the rows after it
     hist.write_text(hist.read_text() + '{"chip": "TPU v5 l\n' + json.dumps(
         {"chip": "TPU v5 lite",
@@ -210,3 +218,25 @@ def test_empirical_wall_gate_uses_history_only_when_cache_primed(
     # unprimed cache or unmeasured label -> static estimate untouched
     assert bench._est_for("gpt2_124m", 400, walls, False) == 400
     assert bench._est_for("bert_base", 400, walls, True) == 400
+
+    # code-fingerprint filter: warm walls must come from rows recorded by
+    # the RUNNING code state — a model edit or EXTRA_CONFIGS kwargs bump
+    # changes the fingerprint and silently reverts to cold static gates
+    fp = bench._code_fingerprint()
+    assert bench._measured_walls("TPU v5 lite", fingerprint=fp) == {}
+    hist.write_text(hist.read_text() + json.dumps(
+        {"chip": "TPU v5 lite", "code_fingerprint": fp,
+         "configs": [{"label": "vit_b16", "wall_s": 90.0},
+                     {"model": "resnet18", "bf16": True,
+                      "per_device_batch": 4096, "wall_s": 200.0}]}) + "\n")
+    assert bench._measured_walls("TPU v5 lite", fingerprint=fp) == \
+        {"vit_b16": 90.0}
+    # the headline cold-reference stays CROSS-fingerprint (a generation
+    # whose first headline ran warm would otherwise never prove warmth):
+    # max(226, 61, 999-capped-400, 200) -> 400
+    assert bench._headline_wall("TPU v5 lite", 4096) == 400.0
+    # ...and history appends stamp the fingerprint automatically
+    monkeypatch.setattr(bench, "HISTORY_PATH", tmp_path / "h2.jsonl")
+    bench._record_history({"metric": "m", "value": 1.0, "configs": []})
+    row = json.loads((tmp_path / "h2.jsonl").read_text())
+    assert row["code_fingerprint"] == fp
