@@ -491,7 +491,7 @@ def _code_fingerprint() -> str:
         pass
     root = Path(__file__).resolve().parent
     files = sorted((root / "distributed_pytorch_training_tpu").rglob("*.py"))
-    for f in [Path(__file__)] + files:
+    for f in [Path(__file__).resolve()] + files:
         try:
             h.update(str(f.relative_to(root)).encode())
             h.update(f.read_bytes())

@@ -41,7 +41,8 @@ from distributed_pytorch_training_tpu.parallel.mesh import (
     batch_shard_count, validate_mesh_usage,
 )
 from distributed_pytorch_training_tpu.runtime import (
-    cleanup_distributed, honor_platform_env, set_seed, setup_distributed,
+    cleanup_distributed, enable_persistent_compile_cache, honor_platform_env,
+    set_seed, setup_distributed,
 )
 
 honor_platform_env()  # JAX_PLATFORMS=cpu virtual-mesh runs work as expected
@@ -113,6 +114,13 @@ def _run(args, guard):
 
     ctx = setup_distributed()  # ref :318
     set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
+    # Reuse compiles across CLI invocations on accelerators (the TPU analogue
+    # of the reference's cudnn.benchmark=True autotune persistence, ref :329).
+    # Repo-local like bench.py/__graft_entry__.py — a per-output-dir cache
+    # would start empty for every fresh experiment dir. Self-gating: refuses
+    # XLA:CPU, whose cache reloads are unsafe here.
+    enable_persistent_compile_cache(
+        Path(__file__).resolve().parent / ".jax_cache")
     mesh = build_mesh(MeshSpec.parse(args.mesh))
     n_batch_shards = batch_shard_count(mesh)
     global_batch = args.batch_size * n_batch_shards
