@@ -32,7 +32,8 @@ Every completed run appends its full result dict (all configs, not just the
 headline line) to experiments/results/bench_history.jsonl with chip kind and
 timestamp, so the README benchmark table is regenerable from committed JSON.
 
-Usage: python bench.py [--batch-size 2048] [--steps 20] [--quick]
+Usage: python bench.py [--batch-size 4096] [--steps 20] [--quick]
+       python bench.py --only gpt2_124m,bert_base   # chunked provenance run
 """
 
 from __future__ import annotations
