@@ -10,6 +10,7 @@ missing #2; the reference's README promises result tables it never fills,
     python -m distributed_pytorch_training_tpu.experiments.report
     python -m distributed_pytorch_training_tpu.experiments.report --latest
     python -m distributed_pytorch_training_tpu.experiments.report --all
+    python -m distributed_pytorch_training_tpu.experiments.report --write
 
 The default MERGES history entries: the full config matrix is measured in
 chunked ``bench.py --only <labels>`` runs (each sized to finish inside one
@@ -149,6 +150,37 @@ def render_merged(entries: List[dict]) -> str:
     return "\n".join(lines)
 
 
+README = HISTORY.parents[3] / "README.md"
+_MARK_BEGIN = "<!-- bench-table:begin"
+_MARK_END = "<!-- bench-table:end -->"
+
+
+def write_readme_table(entries: List[dict], readme: Path = README) -> bool:
+    """Replace the committed-measurements table between the bench-table
+    markers in README.md with the merged render, so the README stays a pure
+    projection of bench_history.jsonl (the reverse direction — trusting a
+    hand-edited table — is what VERDICT r4 called 'indistinguishable from
+    fiction'). Returns True iff the file changed."""
+    text = readme.read_text()
+    try:
+        begin = text.index(_MARK_BEGIN)
+        begin_nl = text.index("\n", begin) + 1
+        end = text.index(_MARK_END, begin_nl)
+    except ValueError:
+        raise SystemExit(
+            f"report: {readme} has no bench-table markers "
+            f"({_MARK_BEGIN} ... {_MARK_END})")
+    # the FULL merged render, preamble included: the preamble carries the
+    # chip kind and vs_baseline, which must be regenerated too — otherwise
+    # the README's speedup claim stays a hand-edited number one paragraph
+    # above freshly generated rows
+    new = text[:begin_nl] + render_merged(entries).strip() + "\n" + text[end:]
+    if new == text:
+        return False
+    readme.write_text(new)
+    return True
+
+
 def load_history(path: Path) -> List[dict]:
     if not path.exists():
         return []
@@ -170,12 +202,17 @@ def load_history(path: Path) -> List[dict]:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--history", default=str(HISTORY))
-    p.add_argument("--all", action="store_true",
-                   help="one summary line per history entry instead of the "
-                        "merged full-matrix table")
-    p.add_argument("--latest", action="store_true",
-                   help="table for the latest entry alone (no merging "
-                        "across chunked runs)")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--all", action="store_true",
+                      help="one summary line per history entry instead of "
+                           "the merged full-matrix table")
+    mode.add_argument("--latest", action="store_true",
+                      help="table for the latest entry alone (no merging "
+                           "across chunked runs)")
+    mode.add_argument("--write", action="store_true",
+                      help="rewrite the committed-measurements table "
+                           "between the bench-table markers in README.md "
+                           "from the merged history")
     args = p.parse_args(argv)
 
     entries = load_history(Path(args.history))
@@ -193,6 +230,11 @@ def main(argv=None) -> int:
         return 0
     if args.latest:
         print(render_table(entries[-1]))
+        return 0
+    if args.write:
+        changed = write_readme_table(entries)
+        print(f"report: README table "
+              f"{'updated' if changed else 'already current'}")
         return 0
     print(render_merged(entries))
     return 0

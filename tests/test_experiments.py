@@ -376,3 +376,42 @@ class TestBenchHistoryHelpers:
         monkeypatch.setattr(bench, "HISTORY_PATH", hist)
         assert bench._history_has(dict(row, salvaged_after_deadline=True))
         assert not bench._history_has(dict(row, value=2.0))
+
+
+def test_report_write_updates_readme_between_markers(tmp_path):
+    """--write keeps the README's committed-measurements table a pure
+    projection of bench_history.jsonl (hand-edited numbers are what VERDICT
+    r4 called 'indistinguishable from fiction'). Idempotent: a second write
+    reports no change."""
+    from distributed_pytorch_training_tpu.experiments import report
+
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "intro\n\n<!-- bench-table:begin (regen hint) -->\nstale\n"
+        "<!-- bench-table:end -->\n\nfooter\n")
+    entries = [{"chip": "TPU v5 lite", "timestamp": "2026-07-31T01:05:56Z",
+                "vs_baseline": 4.135,
+                "configs": [{"model": "resnet18", "bf16": True,
+                             "per_device_batch": 4096,
+                             "samples_per_sec_chip": 459280.51,
+                             "mfu_pct": 52.17}],
+                "configs_skipped": ["gpt2_124m"]}]
+    assert report.write_readme_table(entries, readme) is True
+    text = readme.read_text()
+    assert "stale" not in text
+    assert "459,281" in text and "52.17%" in text
+    assert "still unmeasured on this chip: gpt2_124m" in text
+    assert text.startswith("intro\n\n<!-- bench-table:begin")
+    assert text.rstrip().endswith("footer")
+    # idempotent second write
+    assert report.write_readme_table(entries, readme) is False
+
+    # missing markers must fail loudly, not corrupt the file
+    bare = tmp_path / "bare.md"
+    bare.write_text("no markers here\n")
+    try:
+        report.write_readme_table(entries, bare)
+    except SystemExit as e:
+        assert "markers" in str(e)
+    else:
+        raise AssertionError("expected SystemExit on missing markers")
