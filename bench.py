@@ -75,6 +75,11 @@ EXTRA_CONFIGS = (
     # ~4.3GB params+moments fp32, fits v5e HBM at b=2
     ("gpt2_355m", "gpt2_355m", 420,
      dict(per_device_batch=2, seq_len=1024, steps=6)),
+    # headline batch-scaling probe: b4096 was +13% over b2048; if b8192
+    # measures higher still, it becomes the headline default (activations
+    # ~2x the b4096 run; expected to fit 16G HBM on CIFAR shapes)
+    ("resnet18_b8192", "resnet18", 420,
+     dict(per_device_batch=8192, image_hw=32, num_classes=10, steps=20)),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can

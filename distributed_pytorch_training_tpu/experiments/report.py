@@ -45,7 +45,11 @@ def _label(cfg: dict, headline_model: Optional[str]) -> str:
     name = _LABELS.get(cfg.get("model", "?"), cfg.get("model", "?"))
     if cfg.get("seq_len"):
         name += f" @ S={cfg['seq_len']}"
-    if cfg.get("model") == headline_model and cfg.get("bf16"):
+    # the headline is the LABEL-LESS resnet18 bf16 row; labeled probes of
+    # the same model (e.g. resnet18_b8192) must not render as a second
+    # indistinguishable "(headline)" claim
+    if cfg.get("model") == headline_model and cfg.get("bf16") \
+            and not cfg.get("label"):
         name += " (headline)"
     if not cfg.get("bf16"):
         name = f"&nbsp;&nbsp;same, fp32 `HIGHEST` baseline ({name.strip()})"
