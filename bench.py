@@ -80,6 +80,11 @@ EXTRA_CONFIGS = (
     # ~2x the b4096 run; expected to fit 16G HBM on CIFAR shapes)
     ("resnet18_b8192", "resnet18", 420,
      dict(per_device_batch=8192, image_hw=32, num_classes=10, steps=20)),
+    # true-fp32 arm of the GPT-2 config: extends the measured AMP-vs-FP32
+    # curve (the reference's README:31 experiment) beyond the ResNet
+    # headline to the LM family, same HIGHEST-precision semantics
+    ("gpt2_124m_fp32", "gpt2_124m", 420,
+     dict(per_device_batch=8, seq_len=1024, steps=10, bf16=False)),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can
@@ -454,7 +459,10 @@ def _resolve_provisional_marker(d: dict, only_arg: "str | None") -> None:
     missing = {s for s in skipped if s != "<provisional>"} \
         | (sel - {"headline", "fp32"} - measured)
     if (only_arg is None or "fp32" in sel) and \
-            not any(c.get("bf16") is False for c in d.get("configs", [])):
+            not any(c.get("bf16") is False and not c.get("label")
+                    for c in d.get("configs", [])):
+        # the HEADLINE fp32 arm is the label-less bf16=False config; a
+        # labeled fp32 extra (gpt2_124m_fp32) must not mask its absence
         missing.add("fp32")
     d["configs_skipped"] = sorted(missing)
 
@@ -774,8 +782,9 @@ def _bench(args):
         """Result line for a chunked --only run without the headline: report
         the first selected config; every config is in `configs`."""
         first = extras[0]
+        prec = "bf16" if first.get("bf16") else "fp32"
         return {
-            "metric": f"{first['label']}_train_throughput_bf16",
+            "metric": f"{first['label']}_train_throughput_{prec}",
             "value": first["samples_per_sec_chip"],
             "unit": "samples/sec/chip",
             "vs_baseline": None,
@@ -828,7 +837,8 @@ def _bench(args):
                 skipped.append(label)
                 continue
             try:
-                r = run(name, bf16=True, **kw)
+                # bf16 by default; a config may override (fp32 arms)
+                r = run(name, **{"bf16": True, **kw})
                 r["label"] = label
                 extras.append(r)
                 # Flush a provisional line after EVERY completed config so a

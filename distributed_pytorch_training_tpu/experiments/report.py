@@ -52,7 +52,11 @@ def _label(cfg: dict, headline_model: Optional[str]) -> str:
             and not cfg.get("label"):
         name += " (headline)"
     if not cfg.get("bf16"):
-        name = f"&nbsp;&nbsp;same, fp32 `HIGHEST` baseline ({name.strip()})"
+        # the label-less fp32 row is the headline's baseline arm and renders
+        # indented under it; a labeled fp32 extra stands alone
+        name = (f"{name.strip()} — fp32 `HIGHEST` arm" if cfg.get("label")
+                else f"&nbsp;&nbsp;same, fp32 `HIGHEST` baseline "
+                     f"({name.strip()})")
     return name
 
 

@@ -240,3 +240,25 @@ def test_empirical_wall_gate_uses_history_only_when_cache_primed(
     bench._record_history({"metric": "m", "value": 1.0, "configs": []})
     row = json.loads((tmp_path / "h2.jsonl").read_text())
     assert row["code_fingerprint"] == fp
+
+
+def test_extra_config_bf16_override_and_fp32_arm_identity():
+    """EXTRA_CONFIGS entries default to bf16 but may override it (fp32
+    arms); the salvage marker-resolution must key the HEADLINE fp32 arm on
+    the label-less bf16=False config, so a labeled fp32 extra cannot mask
+    a missing headline arm."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    merged = {label: {"bf16": True, **kw}
+              for label, _, _, kw in bench.EXTRA_CONFIGS}
+    assert merged["gpt2_124m_fp32"]["bf16"] is False
+    assert all(v["bf16"] for k, v in merged.items() if not k.endswith("_fp32"))
+
+    # salvage resolution: gpt2 fp32 extra present, headline fp32 absent
+    d = {"configs": [{"model": "resnet18", "bf16": True},
+                     {"model": "gpt2_124m", "bf16": False,
+                      "label": "gpt2_124m_fp32"}],
+         "configs_skipped": ["<provisional>"]}
+    bench._resolve_provisional_marker(d, None)
+    assert "fp32" in d["configs_skipped"]
