@@ -270,9 +270,40 @@ def _run(args, guard):
             model = GPT2PipeLMHead(**pipe_kwargs)
         else:
             model = get_model(args.model, **lm_kwargs)
+        model_vocab = getattr(model, "vocab_size", None)
+        if model_vocab and model_vocab < train_ds.vocab_size:
+            # A model vocab shrunk below the dataset's stamped vocab can
+            # index past the embedding, and out-of-range jnp gathers fill
+            # with NaN instead of raising — a run that trains straight to
+            # NaN loss with no hint. Scan the ids actually present (only in
+            # this override case — the scan is the price of the shrink, not
+            # of every startup): a byte-tokenized corpus loads under the
+            # gpt2 stamp (50257) yet only uses ids < 256, which is fine.
+            for split_ds, split in ((train_ds, "train"), (val_ds, "val")):
+                max_id = int(split_ds.tokens.max()) if len(split_ds) else -1
+                if max_id >= model_vocab:
+                    raise ValueError(
+                        f"{split} dataset {split_ds.name} contains token id "
+                        f"{max_id}, which exceeds the model's vocab_size "
+                        f"({model_vocab}): such ids index past the "
+                        "embedding, which JAX fills with NaN. Align "
+                        "--model-overrides vocab_size with the data (byte "
+                        f"corpora: 256; full {family} tokens: "
+                        f"{train_ds.vocab_size}).")
         if family == "bert":
-            task = MaskedLMTask(vocab_size=train_ds.vocab_size,
+            # The masking recipe samples replacement ids and inserts [MASK]:
+            # both must stay inside the (possibly shrunk) embedding, or the
+            # task itself manufactures the out-of-range ids the guard above
+            # just excluded from the data.
+            bert_vocab = min(model_vocab or train_ds.vocab_size,
+                             train_ds.vocab_size)
+            task = MaskedLMTask(vocab_size=bert_vocab,
                                 compute_dtype=compute_dtype)
+            if task.mask_token_id >= bert_vocab:
+                raise ValueError(
+                    f"vocab_size {bert_vocab} does not contain the [MASK] "
+                    f"token id {task.mask_token_id}; use a vocab of at "
+                    f"least {task.mask_token_id + 1}")
         elif "moe" in args.model:
             # MoE models add the Switch router load-balancing loss
             task = MoeLanguageModelingTask(compute_dtype=compute_dtype)
