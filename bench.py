@@ -376,8 +376,14 @@ def main(argv=None):
         out, _ = proc.communicate(timeout=args.deadline)
         lines = [l for l in out.decode().splitlines() if l.startswith("{")]
         if lines:
-            print(lines[-1])
-            return rc_for(lines[-1], proc.returncode)
+            # An inner that crashed AFTER flushing a provisional line (OOM
+            # kill mid-extras) never reached its own _record_history — the
+            # measurement must be salvaged exactly like a deadline SIGTERM.
+            line = lines[-1] if proc.returncode == 0 else \
+                _finalize_salvaged(lines[-1], f"inner rc={proc.returncode}",
+                                   args.only)
+            print(line)
+            return rc_for(line, proc.returncode)
         err = f"bench subprocess exited rc={proc.returncode} with no JSON"
     except subprocess.TimeoutExpired:
         died = _stop_gently(proc, group=True)
@@ -398,24 +404,13 @@ def main(argv=None):
             _log(f"bench: deadline hit but a result JSON was already "
                  f"flushed — reporting it")
             # A SIGTERMed inner usually never reached its own
-            # _record_history: append the salvaged measurement here so
-            # provenance survives a deadline (the r5 full-matrix run lost
-            # its history row this way before this branch existed). Guards:
-            # the test hooks must not pollute the committed log (the hang
-            # tests run this parent as a subprocess, out of monkeypatch
-            # reach), and an inner that DID record and then hung in PJRT
-            # teardown must not produce a duplicate row.
-            try:
-                d = json.loads(salvaged)
-                if "error" not in d \
-                        and not os.environ.get("DPT_BENCH_TEST_HANG") \
-                        and not os.environ.get("DPT_BENCH_TEST_WEDGE") \
-                        and not _history_has(d):
-                    d["salvaged_after_deadline"] = True
-                    _resolve_provisional_marker(d, args.only)
-                    _record_history(d)
-            except Exception:
-                pass
+            # _record_history: salvage appends the measurement so provenance
+            # survives a deadline (the r5 full-matrix run lost its history
+            # row this way before this branch existed); an inner that DID
+            # record and then hung in PJRT teardown must not get a
+            # duplicate row (finalize_salvaged's _history_has guard).
+            salvaged = _finalize_salvaged(salvaged, "deadline SIGTERM",
+                                          args.only)
             print(salvaged)
             return rc_for(salvaged, 1)
         err = f"bench exceeded {args.deadline}s deadline (hung backend?)"
@@ -425,6 +420,31 @@ def main(argv=None):
         "error": err,
     }))
     return 1
+
+
+def _finalize_salvaged(line: str, how: str, only_arg: "str | None") -> str:
+    """A measured line the INNER flushed but never finalized itself
+    (deadline SIGTERM, crash, OOM-kill mid-extras): resolve any
+    "<provisional>" marker, append to history exactly once, and return the
+    RESOLVED line — stdout (the driver contract) and the committed history
+    row must agree; the raw line would leak a literal placeholder as data.
+    A line the inner did finalize (last history row matches) or an error
+    line passes through untouched. Test hooks must not pollute the
+    committed log (the hang tests run this parent as a subprocess, out of
+    monkeypatch reach)."""
+    try:
+        d = json.loads(line)
+    except Exception:
+        return line
+    if ("error" in d
+            or os.environ.get("DPT_BENCH_TEST_HANG")
+            or os.environ.get("DPT_BENCH_TEST_WEDGE")
+            or _history_has(d)):
+        return line
+    d["salvaged"] = how
+    _resolve_provisional_marker(d, only_arg)
+    _record_history(d)
+    return json.dumps(d)
 
 
 def _last_good() -> "dict | None":
@@ -471,7 +491,8 @@ def _history_has(result: dict) -> bool:
     """True iff the last history row is the same measurement (the inner
     recorded it, flushed the JSON, then hung in teardown past the deadline).
     Bookkeeping keys the two paths add differently are ignored."""
-    drop = ("timestamp", "salvaged_after_deadline", "code_fingerprint")
+    drop = ("timestamp", "salvaged", "salvaged_after_deadline",
+            "code_fingerprint")
     try:
         last = json.loads(
             HISTORY_PATH.read_text().splitlines()[-1])
@@ -778,9 +799,12 @@ def _bench(args):
         _log("bench: skipped fp32 arm — remaining soft budget "
              f"({time_left():.0f}s) is under its 300s estimate")
 
-    def chunk_result():
+    def chunk_result(provisional=False):
         """Result line for a chunked --only run without the headline: report
-        the first selected config; every config is in `configs`."""
+        the first selected config; every config is in `configs`. Provisional
+        flushes carry the "<provisional>" marker so a salvaged line resolves
+        to the labels that actually never ran (_resolve_provisional_marker)
+        instead of committing `configs_skipped: []` for a truncated chunk."""
         first = extras[0]
         prec = "bf16" if first.get("bf16") else "fp32"
         return {
@@ -793,7 +817,8 @@ def _bench(args):
             "mfu_pct": first["mfu_pct"],
             "only": sorted(only),
             "configs": extras,
-            "configs_skipped": skipped,
+            "configs_skipped": (skipped + ["<provisional>"] if provisional
+                                else skipped),
             "bench_seconds": round(time.monotonic() - t_start, 1),
         }
 
@@ -846,7 +871,8 @@ def _bench(args):
                 # measured work (the parent salvages the last flushed JSON
                 # line) — in chunked runs and full-matrix runs alike.
                 if headline is None:
-                    print(json.dumps(chunk_result()), flush=True)
+                    print(json.dumps(chunk_result(provisional=True)),
+                          flush=True)
                 else:
                     print(json.dumps(result_dict(
                         headline, fp32, extras,
