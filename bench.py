@@ -44,6 +44,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from pathlib import Path
@@ -111,6 +112,26 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _relay_ports() -> "list[int]":
+    """Configured local relay ports (DPT_RELAY_PORTS, default 8082/8083) —
+    shared by _tunnel_status and the deathwatch so the two liveness views
+    can never diverge."""
+    return [int(p) for p in
+            os.environ.get("DPT_RELAY_PORTS", "8082,8083").split(",")
+            if p.strip().isdigit()]
+
+
+def _port_listening(port: int) -> bool:
+    """200ms TCP connect probe of one local relay port."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+            return True
+    except Exception:
+        return False
+
+
 def _tunnel_status() -> "str | None":
     """Liveness of the tunneled backend's local relay ports, if any.
 
@@ -127,21 +148,11 @@ def _tunnel_status() -> "str | None":
     like an ImportError never carry a relay hint. Returns None only when
     DPT_RELAY_PORTS is set but contains no usable port numbers.
     """
-    import socket
-
-    ports = [p.strip() for p in
-             os.environ.get("DPT_RELAY_PORTS", "8082,8083").split(",")
-             if p.strip().isdigit()]
+    ports = _relay_ports()
     if not ports:
         return None
-    status = {}
-    for p in ports:
-        try:
-            with socket.create_connection(("127.0.0.1", int(p)),
-                                          timeout=0.2):
-                status[p] = "listening"
-        except Exception:
-            status[p] = "closed"
+    status = {p: ("listening" if _port_listening(p) else "closed")
+              for p in ports}
     if all(v == "closed" for v in status.values()):
         if "DPT_RELAY_PORTS" in os.environ:
             return "relay tunnel DOWN (all relay ports closed; no " \
@@ -160,6 +171,81 @@ def _tunnel_status() -> "str | None":
         "is the tunneled environment the tunnel is up and a hang is a " \
         "stuck server-side grant; set DPT_RELAY_PORTS to make this check " \
         "authoritative"
+
+
+def _start_relay_deathwatch(interval_s: "float | None" = None):
+    """Abort the inner promptly when the local relay tunnel dies mid-run.
+
+    The tunneled backend's device RPCs and remote compiles ride localhost
+    relay ports; when the relay process dies, the client sleep-retries
+    UNAVAILABLE for tens of minutes (observed live twice: a 40-minute
+    gpt2_124m compile block on 03:19, a 24+-minute vit_b16 block on 12:09 —
+    CHIP_STATUS.md) until the parent watchdog SIGTERMs it, which also risks
+    wedging the server-side grant. A dead relay has no client-side remedy,
+    so blocking is pure loss: this daemon thread samples the armed relay
+    ports and, once ANY of them is closed on two consecutive samples
+    (partial relay death hangs compiles just like total death — observed
+    live 03:19), logs and `os._exit(70)`.
+    The parent's crash-salvage branch (inner rc=70) then records and
+    reports any already-flushed measurement. Arms ONLY if some relay port
+    was listening at start — on non-tunneled machines (CPU tests, real
+    multi-host pods) it is a no-op. os._exit, not sys.exit: a clean PJRT
+    teardown through a dead socket is exactly the hang being escaped."""
+    # Lethal action needs an authoritative signal: arm ONLY when
+    # DPT_RELAY_PORTS is explicitly set (the same line _tunnel_status
+    # draws). Default-port heuristics would let an unrelated dev service
+    # on 8082 of a non-tunneled machine kill a healthy run by restarting.
+    # The chunk runner / operator opts in by exporting DPT_RELAY_PORTS.
+    if "DPT_RELAY_PORTS" not in os.environ:
+        return None
+    # Watch only the ports that are LISTENING at arm time: a port already
+    # dead now means a tunnel that is already degraded — tripping on it
+    # immediately would be wrong. A partially dead relay (compile port
+    # down, device port up) DOES hang compiles (observed live 03:19:
+    # /remote_compile refused while the client retried 40 min), so ANY
+    # armed port going dark counts as a miss.
+    armed = [p for p in _relay_ports() if _port_listening(p)]
+    if not armed:
+        return None  # not a tunneled environment (or already dead at start)
+    interval = interval_s if interval_s is not None else \
+        float(os.environ.get("DPT_RELAY_WATCH_INTERVAL", "30"))
+    _log(f"bench: relay deathwatch armed on ports {armed} "
+         f"(interval {interval:g}s)")
+
+    def watch():
+        # Per-port consecutive-miss counters: a lethal abort needs the SAME
+        # port dark on two samples in a row. A global counter would let two
+        # transient blips on two different ports (e.g. 200ms connects timing
+        # out against a saturated-but-alive relay) kill a healthy compile.
+        misses = {p: 0 for p in armed}
+        while True:
+            time.sleep(interval)
+            for p in armed:
+                misses[p] = misses[p] + 1 if not _port_listening(p) else 0
+            dead = [p for p in armed if misses[p] >= 2]
+            if dead:
+                _log(f"bench: relay tunnel DIED mid-run (ports {dead} "
+                     "closed on two consecutive samples) — exiting now "
+                     "instead of hanging in UNAVAILABLE retries until the "
+                     "watchdog SIGTERM; flushed measurements are salvaged "
+                     "by the parent (inner rc=70)")
+                # Reap our own subprocesses first (a backend probe may be
+                # blocked mid-jax.devices(): orphaning it would leave a
+                # stale claim-holder — the invariant _stop_gently exists
+                # for). signal.signal is main-thread-only, so no group
+                # SIGTERM from here; the live-probe registry names them.
+                # Flag-set is ordered against probe spawn by _PROBE_LOCK:
+                # after the lock releases, every live probe is registered
+                # and no new one can spawn.
+                with _PROBE_LOCK:
+                    _RELAY_DEAD.set()
+                for p in list(_LIVE_PROBES):
+                    _stop_gently(p, grace_s=5.0)
+                os._exit(70)
+
+    t = threading.Thread(target=watch, daemon=True, name="relay-deathwatch")
+    t.start()
+    return t
 
 
 def _stop_gently(proc: subprocess.Popen, grace_s: float = 15.0,
@@ -189,19 +275,38 @@ def _stop_gently(proc: subprocess.Popen, grace_s: float = 15.0,
         return False
 
 
+# Live backend-probe subprocesses, registered so the relay deathwatch can
+# SIGTERM them before it aborts the inner — an orphaned probe mid-
+# jax.devices() would keep the TPU claim past the inner's death.
+_LIVE_PROBES: "set[subprocess.Popen]" = set()
+# Set by the deathwatch the moment it decides to abort: no NEW probe may
+# spawn during the reap-then-exit window (a probe launched there would be
+# orphaned by os._exit holding the TPU claim). _PROBE_LOCK orders probe
+# spawn+registration against flag-set+sweep: whichever side takes the lock
+# first, a spawned probe is either visible to the sweep or never spawned.
+_RELAY_DEAD = threading.Event()
+_PROBE_LOCK = threading.Lock()
+
+
 def probe_backend(timeout_s: float):
     """Run one disposable backend probe. Returns (ok, detail, orphaned) —
     orphaned means the probe survived SIGTERM and may still hold the TPU
     claim, so further probes cannot succeed until it dies."""
-    proc = subprocess.Popen(
-        [sys.executable, "-c", _PROBE_SRC],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    with _PROBE_LOCK:
+        if _RELAY_DEAD.is_set():
+            return False, "relay tunnel died (deathwatch firing)", False
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        _LIVE_PROBES.add(proc)
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         died = _stop_gently(proc)
         return False, f"probe hung >{timeout_s:.0f}s (wedged backend?)", \
             not died
+    finally:
+        _LIVE_PROBES.discard(proc)
     out = out.decode(errors="replace")
     ok_line = next((l for l in out.splitlines() if l.startswith("OK ")), None)
     if proc.returncode == 0 and ok_line:
@@ -635,6 +740,10 @@ def _record_history(result: dict) -> None:
 
 def _bench(args):
     t_start = time.monotonic()
+    # Armed before anything can block on the tunnel (incl. the test hooks):
+    # a dead relay turns every later RPC into an unbounded UNAVAILABLE
+    # retry loop, so the watch must outlive every phase of the run.
+    _start_relay_deathwatch()
     # Soft deadline: leave margin under the parent watchdog so we can skip
     # remaining configs and still print the headline JSON ourselves instead
     # of being SIGTERMed mid-measure with the result lost.

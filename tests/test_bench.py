@@ -316,3 +316,68 @@ def test_finalize_salvaged_records_and_resolves(tmp_path, monkeypatch):
     err_line = json.dumps({"metric": "m", "value": 0.0, "error": "boom"})
     assert bench._finalize_salvaged(err_line, "x", None) == err_line
     assert len(hist.read_text().splitlines()) == 1
+
+
+def test_relay_deathwatch_aborts_inner_when_tunnel_dies(tmp_path):
+    """A relay that dies mid-run must abort the inner within ~2 sample
+    intervals (rc=70) instead of hanging in UNAVAILABLE retries until the
+    watchdog SIGTERM (observed live: 24+ min of blocked compile,
+    CHIP_STATUS.md 12:09). PARTIAL death counts: losing just the compile
+    port hangs compiles the same way (03:19: /remote_compile refused, 40
+    min retry loop), so only ONE of the two armed ports dies here. The
+    parent's crash-salvage branch then keeps any flushed measurement."""
+    import socket
+    import time
+
+    def listener():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(8)
+        return s
+
+    srv_dies, srv_stays = listener(), listener()
+    ports = f"{srv_dies.getsockname()[1]},{srv_stays.getsockname()[1]}"
+
+    def accept_forever(s):
+        # a real relay accepts; without this the watch's liveness probes
+        # fill the backlog and the STAYING port would read as down too
+        while True:
+            try:
+                conn, _ = s.accept()
+                conn.close()
+            except OSError:
+                return
+
+    import threading
+    threading.Thread(target=accept_forever, args=(srv_stays,),
+                     daemon=True).start()
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "DPT_RELAY_PORTS": ports,
+                "DPT_RELAY_WATCH_INTERVAL": "0.3",
+                "DPT_BENCH_TEST_HANG": "1"})
+    errf = tmp_path / "deathwatch_stderr.log"
+    with open(errf, "wb") as errh:
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "bench.py"), "--_inner",
+             "--deadline", "120"],
+            stdout=subprocess.PIPE, stderr=errh, env=env, cwd=str(REPO))
+    try:
+        # wait for the ARMED log line — closing the listener before the
+        # inner's arm-time check correctly DISARMS the watch (not a
+        # tunneled environment), which is not the scenario under test
+        deadline = time.time() + 60
+        while b"deathwatch armed" not in errf.read_bytes():
+            assert time.time() < deadline, errf.read_bytes()[-500:]
+            assert proc.poll() is None, errf.read_bytes()[-500:]
+            time.sleep(0.2)
+        srv_dies.close()  # the compile port "dies"; the other stays up
+        proc.wait(timeout=30)
+        assert proc.returncode == 70, (proc.returncode,
+                                       errf.read_bytes()[-500:])
+        assert b"relay tunnel DIED" in errf.read_bytes()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        srv_dies.close()
+        srv_stays.close()
