@@ -173,7 +173,8 @@ def _tunnel_status() -> "str | None":
         "authoritative"
 
 
-def _start_relay_deathwatch(interval_s: "float | None" = None):
+def _start_relay_deathwatch(interval_s: "float | None" = None,
+                            assume_tunneled: bool = False):
     """Abort the inner promptly when the local relay tunnel dies mid-run.
 
     The tunneled backend's device RPCs and remote compiles ride localhost
@@ -195,8 +196,11 @@ def _start_relay_deathwatch(interval_s: "float | None" = None):
     # DPT_RELAY_PORTS is explicitly set (the same line _tunnel_status
     # draws). Default-port heuristics would let an unrelated dev service
     # on 8082 of a non-tunneled machine kill a healthy run by restarting.
-    # The chunk runner / operator opts in by exporting DPT_RELAY_PORTS.
-    if "DPT_RELAY_PORTS" not in os.environ:
+    # The chunk runner / operator opts in by exporting DPT_RELAY_PORTS;
+    # alternatively the caller passes assume_tunneled=True once a
+    # successful backend probe on the TPU platform has CONFIRMED the
+    # tunnel (the driver's plain `python bench.py` sets no env).
+    if "DPT_RELAY_PORTS" not in os.environ and not assume_tunneled:
         return None
     # Watch only the ports that are LISTENING at arm time: a port already
     # dead now means a tunnel that is already degraded — tripping on it
@@ -743,7 +747,7 @@ def _bench(args):
     # Armed before anything can block on the tunnel (incl. the test hooks):
     # a dead relay turns every later RPC into an unbounded UNAVAILABLE
     # retry loop, so the watch must outlive every phase of the run.
-    _start_relay_deathwatch()
+    deathwatch = _start_relay_deathwatch()
     # Soft deadline: leave margin under the parent watchdog so we can skip
     # remaining configs and still print the headline JSON ourselves instead
     # of being SIGTERMed mid-measure with the result lost.
@@ -810,6 +814,19 @@ def _bench(args):
             "last_good_committed_run": _last_good(),
         }))
         return 1
+
+    # The tunneled single-chip client is the `axon` PJRT plugin — a real
+    # (non-tunneled) TPU host never loads it, so "axon" in jax_platforms
+    # plus a successful TPU init CONFIRMS the tunnel. Only then may the
+    # watch auto-arm on the default relay ports without an explicit
+    # DPT_RELAY_PORTS (the driver's plain `python bench.py` sets no env,
+    # and a mid-run relay death there would otherwise hang the measured
+    # configs into the watchdog SIGTERM). A plain platform=="tpu" gate
+    # would reintroduce the default-port false-kill hazard on real pods.
+    tunneled = "axon" in str(
+        getattr(jax.config, "jax_platforms", None) or "")
+    if deathwatch is None and devices[0].platform == "tpu" and tunneled:
+        deathwatch = _start_relay_deathwatch(assume_tunneled=True)
 
     from distributed_pytorch_training_tpu.experiments.harness import (
         measure_config,
