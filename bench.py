@@ -86,6 +86,16 @@ EXTRA_CONFIGS = (
     # headline to the LM family, same HIGHEST-precision semantics
     ("gpt2_124m_fp32", "gpt2_124m", 420,
      dict(per_device_batch=8, seq_len=1024, steps=10, bf16=False)),
+    # ZeRO-1 sharded-weight-update arms (training/loop.py zero1): on one
+    # chip the mode is an identity passthrough (same numbers as the plain
+    # config — a cheap regression canary); on multi-chip meshes these rows
+    # are the replicated-vs-sharded comparison the scaling target needs
+    # (experiments/scaling.py `zero1` is the full instrumented arm)
+    ("resnet18_zero1", "resnet18", 420,
+     dict(per_device_batch=4096, image_hw=32, num_classes=10, steps=20,
+          zero1=True)),
+    ("gpt2_124m_zero1", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10, zero1=True)),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can
@@ -113,20 +123,26 @@ def _log(msg: str) -> None:
 
 
 def _relay_ports() -> "list[int]":
-    """Configured local relay ports (DPT_RELAY_PORTS, default 8082/8083) —
-    shared by _tunnel_status and the deathwatch so the two liveness views
-    can never diverge."""
+    """Configured local relay ports (DPT_RELAY_PORTS, default
+    8082/8083/8087 — the three ports CHIP_STATUS.md documents the relay
+    listening on; omitting 8087 left the deathwatch blind to an 8087-only
+    partial death, ADVICE r5 #1) — shared by _tunnel_status and the
+    deathwatch so the two liveness views can never diverge."""
     return [int(p) for p in
-            os.environ.get("DPT_RELAY_PORTS", "8082,8083").split(",")
+            os.environ.get("DPT_RELAY_PORTS", "8082,8083,8087").split(",")
             if p.strip().isdigit()]
 
 
-def _port_listening(port: int) -> bool:
-    """200ms TCP connect probe of one local relay port."""
+def _port_listening(port: int, timeout: float = 0.2) -> bool:
+    """TCP connect probe of one local relay port. The 200ms default suits
+    the advisory _tunnel_status diagnosis; the LETHAL deathwatch probe
+    passes a longer timeout so a relay that is alive but slow to accept
+    (backlog full during a heavy compile/transfer) is not misread as dead
+    (ADVICE r5 #2)."""
     import socket
 
     try:
-        with socket.create_connection(("127.0.0.1", port), timeout=0.2):
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
             return True
     except Exception:
         return False
@@ -167,7 +183,8 @@ def _tunnel_status() -> "str | None":
     confident = "DPT_RELAY_PORTS" in os.environ
     return "relay ports listening (tunnel up; a hang past this point is a " \
         "stuck server-side grant, not a dead relay)" if confident else \
-        "default relay ports (8082/8083) have listeners — IF this machine " \
+        "default relay ports (8082/8083/8087) have listeners — IF this " \
+        "machine " \
         "is the tunneled environment the tunnel is up and a hang is a " \
         "stuck server-side grant; set DPT_RELAY_PORTS to make this check " \
         "authoritative"
@@ -184,14 +201,21 @@ def _start_relay_deathwatch(interval_s: "float | None" = None,
     CHIP_STATUS.md) until the parent watchdog SIGTERMs it, which also risks
     wedging the server-side grant. A dead relay has no client-side remedy,
     so blocking is pure loss: this daemon thread samples the armed relay
-    ports and, once ANY of them is closed on two consecutive samples
+    ports and, once ANY of them is closed on THREE consecutive samples
     (partial relay death hangs compiles just like total death — observed
-    live 03:19), logs and `os._exit(70)`.
+    live 03:19), logs and `os._exit(70)`. Three misses with a 1.5s connect
+    timeout per probe (vs the advisory 200ms): a lethal abort must not fire
+    on a relay that is alive but slow to accept under load (ADVICE r5 #2).
     The parent's crash-salvage branch (inner rc=70) then records and
     reports any already-flushed measurement. Arms ONLY if some relay port
     was listening at start — on non-tunneled machines (CPU tests, real
     multi-host pods) it is a no-op. os._exit, not sys.exit: a clean PJRT
-    teardown through a dead socket is exactly the hang being escaped."""
+    teardown through a dead socket is exactly the hang being escaped — but
+    when SOME armed port is still alive (partial death), a BOUNDED
+    best-effort PJRT client close runs first, because an abrupt exit while
+    holding the TPU claim over the still-live device port is the stuck-
+    grant scenario _stop_gently warns about (observed 12:10-12:56, hours to
+    clear; ADVICE r5 #3)."""
     # Lethal action needs an authoritative signal: arm ONLY when
     # DPT_RELAY_PORTS is explicitly set (the same line _tunnel_status
     # draws). Default-port heuristics would let an unrelated dev service
@@ -208,7 +232,7 @@ def _start_relay_deathwatch(interval_s: "float | None" = None,
     # down, device port up) DOES hang compiles (observed live 03:19:
     # /remote_compile refused while the client retried 40 min), so ANY
     # armed port going dark counts as a miss.
-    armed = [p for p in _relay_ports() if _port_listening(p)]
+    armed = [p for p in _relay_ports() if _port_listening(p, timeout=1.5)]
     if not armed:
         return None  # not a tunneled environment (or already dead at start)
     interval = interval_s if interval_s is not None else \
@@ -218,18 +242,22 @@ def _start_relay_deathwatch(interval_s: "float | None" = None,
 
     def watch():
         # Per-port consecutive-miss counters: a lethal abort needs the SAME
-        # port dark on two samples in a row. A global counter would let two
-        # transient blips on two different ports (e.g. 200ms connects timing
-        # out against a saturated-but-alive relay) kill a healthy compile.
+        # port dark on three samples in a row, each probed with a 1.5s
+        # connect timeout (the advisory 200ms probe misreads a saturated-
+        # but-alive relay). A global counter would let transient blips on
+        # different ports kill a healthy compile.
         misses = {p: 0 for p in armed}
         while True:
             time.sleep(interval)
             for p in armed:
-                misses[p] = misses[p] + 1 if not _port_listening(p) else 0
-            dead = [p for p in armed if misses[p] >= 2]
+                misses[p] = (misses[p] + 1
+                             if not _port_listening(p, timeout=1.5) else 0)
+            dead = [p for p in armed if misses[p] >= 3]
             if dead:
+                alive = [p for p in armed
+                         if p not in dead and _port_listening(p, timeout=1.5)]
                 _log(f"bench: relay tunnel DIED mid-run (ports {dead} "
-                     "closed on two consecutive samples) — exiting now "
+                     "closed on three consecutive samples) — exiting now "
                      "instead of hanging in UNAVAILABLE retries until the "
                      "watchdog SIGTERM; flushed measurements are salvaged "
                      "by the parent (inner rc=70)")
@@ -245,11 +273,57 @@ def _start_relay_deathwatch(interval_s: "float | None" = None,
                     _RELAY_DEAD.set()
                 for p in list(_LIVE_PROBES):
                     _stop_gently(p, grace_s=5.0)
+                if alive:
+                    # PARTIAL death: this process may still hold the TPU
+                    # claim over a live device port, and an abrupt exit can
+                    # wedge the server-side grant for hours (observed
+                    # 12:10-12:56). Attempt a clean PJRT client close,
+                    # bounded to a few seconds — the dead port can hang any
+                    # teardown RPC, so the attempt runs in a daemon thread
+                    # we abandon at the deadline rather than join.
+                    _try_clean_pjrt_close(timeout_s=5.0)
                 os._exit(70)
 
     t = threading.Thread(target=watch, daemon=True, name="relay-deathwatch")
     t.start()
     return t
+
+
+def _try_clean_pjrt_close(timeout_s: float = 5.0) -> None:
+    """Best-effort, time-boxed release of the PJRT client (and with it the
+    server-side TPU grant) before a deathwatch abort on PARTIAL relay death.
+
+    Only meaningful when jax is already loaded and initialized in this
+    process (otherwise there is no claim to release — importing jax here
+    would CREATE one). The close itself can hang on the dead half of the
+    relay, so it runs in a daemon thread that os._exit abandons after
+    `timeout_s` — a bounded attempt, never a new hang (ADVICE r5 #3)."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return
+    done = threading.Event()
+
+    def close():
+        try:
+            # clear_backends tears down the live PJRT client(s); the public
+            # name moved across jax versions, so probe both homes.
+            clear = getattr(jax_mod, "clear_backends", None)
+            if clear is None:
+                from jax.extend import backend as jex_backend
+                clear = getattr(jex_backend, "clear_backends", None)
+            if clear is not None:
+                clear()
+                _log("bench: PJRT client closed cleanly before abort")
+        except Exception as e:
+            _log(f"bench: clean PJRT close failed ({e}); aborting anyway")
+        finally:
+            done.set()
+
+    t = threading.Thread(target=close, daemon=True, name="pjrt-close")
+    t.start()
+    if not done.wait(timeout_s):
+        _log(f"bench: clean PJRT close still blocked after {timeout_s:.0f}s "
+             "— abandoning it (the dead relay port is unrecoverable)")
 
 
 def _stop_gently(proc: subprocess.Popen, grace_s: float = 15.0,

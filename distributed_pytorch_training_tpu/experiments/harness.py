@@ -29,9 +29,10 @@ import numpy as np
 
 def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
                         model_name: str = "resnet18", image_hw: int = 32,
-                        num_classes: int = 10):
+                        num_classes: int = 10, zero1: bool = False):
     """(trainer, state, mesh) for an image-classification config on a pure-DP
-    mesh over `devices` (the benchmark workload, BASELINE.json:8)."""
+    mesh over `devices` (the benchmark workload, BASELINE.json:8).
+    ``zero1`` switches the trainer to the sharded weight update."""
     from ..data import CIFAR10_MEAN, CIFAR10_STD
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
@@ -44,7 +45,8 @@ def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
     model = get_model(model_name, num_classes=num_classes, dtype=dtype)
     task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
                                    augment=True, compute_dtype=dtype)
-    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16))
+    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16,
+                                              zero1=zero1))
     state = trainer.init_state(
         model, np.zeros((1, image_hw, image_hw, 3), np.float32),
         sgd(0.1, momentum=0.9, weight_decay=5e-4), jax.random.PRNGKey(0))
@@ -62,7 +64,8 @@ def lm_vocab(model_name: str) -> int:
 
 def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
                      model_name: str, seq_len: int,
-                     model_kwargs: Optional[dict] = None):
+                     model_kwargs: Optional[dict] = None,
+                     zero1: bool = False):
     """(trainer, state, mesh) for a language-model config (gpt2_*/bert_base,
     BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes.
     `model_kwargs` overrides architecture fields (CI smoke runs shrink the
@@ -104,24 +107,35 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
         task = MoeLanguageModelingTask(compute_dtype=dtype)
     else:
         task = LanguageModelingTask(compute_dtype=dtype)
-    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16),
+    from ..parallel.mesh import BATCH_AXES, batch_shard_count
+
+    trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16,
+                                              zero1=zero1),
                       rules=type(model).partition_rules())
+    # zero1 shards the update; the AdamW global-norm clip must psum across
+    # the shards or each replica clips by its own shard's norm (optim.py).
+    # On a single batch shard the Trainer runs the replicated (non-
+    # shard_map) path, where a psum over the batch axes would hit unbound
+    # axis names — shard_axes must follow the SAME passthrough condition.
+    sharded = zero1 and batch_shard_count(mesh) > 1
+    tx = adamw(1e-4, shard_axes=BATCH_AXES if sharded else None)
     state = trainer.init_state(model, np.zeros((1, seq_len), np.int32),
-                               adamw(1e-4), jax.random.PRNGKey(0))
+                               tx, jax.random.PRNGKey(0))
     return trainer, state, mesh
 
 
 def build_trainer(devices: Sequence[jax.Device], bf16: bool, model_name: str,
                   seq_len: int = 512, image_hw: int = 32,
                   num_classes: int = 10,
-                  lm_overrides: Optional[dict] = None):
+                  lm_overrides: Optional[dict] = None,
+                  zero1: bool = False):
     """Model-family dispatch used by bench.py AND the experiment drivers —
     the same `--model` string must measure the same config everywhere."""
     if is_lm_model(model_name):
         return build_lm_trainer(devices, bf16, model_name, seq_len,
-                                lm_overrides)
+                                lm_overrides, zero1=zero1)
     return build_image_trainer(devices, bf16, model_name, image_hw,
-                               num_classes)
+                               num_classes, zero1=zero1)
 
 
 def make_synth_batch(mesh, model_name: str, per_device_batch: int,
@@ -247,7 +261,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                    bf16: bool, repeats: int = 3, seq_len: int = 512,
                    image_hw: int = 32, num_classes: int = 10,
                    devices: Optional[Sequence[jax.Device]] = None,
-                   true_fp32: bool = True, min_window_s: float = 0.5) -> dict:
+                   true_fp32: bool = True, min_window_s: float = 0.5,
+                   zero1: bool = False) -> dict:
     """Full self-verifying measurement of one training config.
 
     Returns a dict with samples/s, FLOPs from XLA cost analysis AND the
@@ -272,7 +287,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
            if (not bf16 and true_fp32) else contextlib.nullcontext())
     with ctx:
         trainer, state, mesh = build_trainer(
-            devices, bf16, model_name, seq_len, image_hw, num_classes)
+            devices, bf16, model_name, seq_len, image_hw, num_classes,
+            zero1=zero1)
         batch, global_batch = make_synth_batch(
             mesh, model_name, per_device_batch, seq_len, image_hw,
             num_classes)
@@ -319,6 +335,7 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     result = {
         "model": model_name,
         "bf16": bf16,
+        **({"zero1": True} if zero1 else {}),
         "per_device_batch": per_device_batch,
         "global_batch": global_batch,
         "steps_per_sec": round(sps, 4),

@@ -9,6 +9,10 @@ outline (/root/reference/README.md:27-35):
 * ``batch``    — throughput vs per-device batch size.
 * ``amp``      — bf16 vs fp32 step time (the "AMP vs FP32" comparison; on TPU
   bf16 replaces CUDA AMP, no GradScaler — SURVEY.md §2b).
+* ``zero1``    — replicated vs ZeRO-1 sharded weight update (reduce-scatter
+  grads, 1/N optimizer update per replica, all-gather params — Xu et al.,
+  PAPERS.md) on the same data-parallel mesh, with the static weight-update
+  census proving which collectives each compiled step actually runs.
 * ``gradsync`` — the gradient-synchronization share of step time (the
   README's literal "~X%" placeholder, README.md:35). Three instruments:
   (a) measured: per-device-constant-batch step time on 1 chip vs N chips —
@@ -33,7 +37,6 @@ from __future__ import annotations
 
 import argparse
 import csv as csv_mod
-import re
 import sys
 import time
 from pathlib import Path
@@ -59,7 +62,7 @@ from .harness import build_trainer, is_lm_model, make_synth_batch, timed_steps  
 _LM_TINY = dict(hidden_dim=64, depth=2, num_heads=2, mlp_dim=128)
 
 
-def _setup(devices, bf16: bool, args, per_device_batch=None):
+def _setup(devices, bf16: bool, args, per_device_batch=None, zero1=False):
     """(trainer, state, mesh, batch, global_batch) for args' config — the
     trainer and its batch are built together so they can never mismatch."""
     lm_kw = None
@@ -68,7 +71,8 @@ def _setup(devices, bf16: bool, args, per_device_batch=None):
         if args.model.startswith("gpt2"):
             lm_kw.pop("mlp_dim")  # gpt2 derives mlp from hidden_dim
     trainer, state, mesh = build_trainer(devices, bf16, args.model,
-                                         args.seq_len, lm_overrides=lm_kw)
+                                         args.seq_len, lm_overrides=lm_kw,
+                                         zero1=zero1)
     batch, gb = make_synth_batch(mesh, args.model,
                                  per_device_batch or args.batch_size,
                                  args.seq_len)
@@ -157,31 +161,12 @@ def run_amp(args) -> List[dict]:
     return rows
 
 
-# HLO text: `%name = shape op-name(...)`. On TPU the latency-hiding scheduler
-# splits collectives into async `-start`/`-done` pairs; count the `-start`
-# half (and bare sync forms), never `-done`, so each collective counts once.
-_COLLECTIVE_RE = re.compile(
-    r"=\s*(\([^)]*\)|\S+)\s+"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(-start|-done)?[.\w]*\(")
-
-
-def collective_census(compiled_text: str) -> List[dict]:
-    """Census of collective ops in optimized HLO text: op kind + result shape.
-
-    The static half of the grad-sync analysis: what the compiler actually
-    scheduled (names/shapes straight from the executable), standing in for
-    the reference's promised profiler-timeline read-off (README.md:35)."""
-    rows = {}
-    for m in _COLLECTIVE_RE.finditer(compiled_text):
-        shape, kind, suffix = m.group(1), m.group(2), m.group(3)
-        if suffix == "-done":
-            continue  # the paired completion of an async -start
-        key = (kind, shape)
-        if key not in rows:
-            rows[key] = {"op": kind, "result_shape": shape, "count": 0}
-        rows[key]["count"] += 1
-    return sorted(rows.values(), key=lambda r: (r["op"], r["result_shape"]))
+# The static HLO census lives with the other gradient-sync instruments in
+# trace_analysis.py; re-exported here because this module is its historical
+# home (tests and notebooks import it from scaling).
+from .trace_analysis import (  # noqa: E402,F401
+    collective_census, weight_update_census,
+)
 
 
 def run_gradsync(args) -> List[dict]:
@@ -244,6 +229,48 @@ def run_gradsync(args) -> List[dict]:
             print(f"  {c['count']:>3}x {c['op']:<20} {c['result_shape']}")
         if not census:
             print("  (none — single-device or fully fused)")
+    return rows
+
+
+def run_zero1(args) -> List[dict]:
+    """Replicated vs ZeRO-1 sharded weight update on the same devices.
+
+    The experiment the zero1 flag exists for (Xu et al., PAPERS.md): same
+    model, same data-parallel mesh, once with the replicated DDP-style
+    update and once with reduce-scatter/sharded-update/all-gather. Reports
+    throughput plus the static weight-update census of each compiled step —
+    the census must show the gradient all-reduces GONE in the zero1 arm
+    (replaced by reduce-scatter + all-gather), or the mode is silently not
+    engaged and the throughput comparison measures nothing.
+    """
+    devices = jax.devices()
+    if len(devices) < 2:
+        return [{"update": "skipped",
+                 "global_samples_per_s": "needs >= 2 devices"}]
+    rows = []
+    sps_by_mode = {}
+    for zero1 in (False, True):
+        trainer, state, _, batch, gb = _setup(devices, args.bf16, args,
+                                              zero1=zero1)
+        # Lower/compile BEFORE the timed run (donation deletes state buffers
+        # on backends that honor it — same ordering as run_gradsync).
+        compiled = trainer._train_step.lower(
+            state, batch, jax.random.PRNGKey(0)).compile()
+        census = weight_update_census(compiled.as_text())
+        _, sps = _measure(trainer, state, batch, gb, args)
+        sps_by_mode[zero1] = sps
+        rows.append({
+            "update": "zero1" if zero1 else "replicated",
+            "global_samples_per_s": round(sps, 1),
+            "grad_all_reduce": census["all-reduce"],
+            "reduce_scatter": census["reduce-scatter"],
+            "all_gather": census["all-gather"],
+        })
+    rows.append({"update": "zero1_speedup",
+                 "global_samples_per_s":
+                     round(sps_by_mode[True] / sps_by_mode[False], 3),
+                 "grad_all_reduce": "", "reduce_scatter": "",
+                 "all_gather": ""})
     return rows
 
 
@@ -324,7 +351,8 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
-                   choices=["scaling", "batch", "amp", "gradsync", "pipeline"])
+                   choices=["scaling", "batch", "amp", "gradsync", "zero1",
+                            "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
@@ -349,7 +377,8 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
-          "gradsync": run_gradsync, "pipeline": run_pipeline}[args.experiment]
+          "gradsync": run_gradsync, "zero1": run_zero1,
+          "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "
           f"{len(jax.devices())} device(s) [{jax.default_backend()}]\n")

@@ -10,9 +10,10 @@ writes (`plugins/profile/<ts>/<host>.trace.json.gz`) — no tensorboard plugin
 needed.
 
 Instruments in experiments/scaling.py `gradsync`, cross-checked three ways:
-(a) measured 1-vs-N step-time delta, (b) static HLO collective census,
-(c) THIS trace-derived share (the profiler-timeline read-off the README
-   placeholder calls for).
+(a) measured 1-vs-N step-time delta, (b) static HLO collective census
+(`collective_census` below, plus the zero1 weight-update classification
+`weight_update_census`/`verify_zero1_collectives`), (c) the trace-derived
+share (the profiler-timeline read-off the README placeholder calls for).
 """
 
 from __future__ import annotations
@@ -125,6 +126,110 @@ def collective_share(log_dir: str) -> dict:
         "share_pct": round(100.0 * coll_us / op_us, 2) if op_us else 0.0,
         "by_op": {k: round(v, 1) for k, v in sorted(by_op.items())},
     }
+
+
+# ---------------------------------------------------------------------------
+# Static HLO collective census (the compile-time half of the gradient-sync
+# analysis; the trace functions above are the runtime half).
+# ---------------------------------------------------------------------------
+
+# HLO text: `%name = shape op-name(...)`. On TPU the latency-hiding scheduler
+# splits collectives into async `-start`/`-done` pairs; count the `-start`
+# half (and bare sync forms), never `-done`, so each collective counts once.
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"(-start|-done)?[.\w]*\(")
+
+# One array shape inside an HLO result: "f32[1000,512]{1,0}" (possibly inside
+# a tuple). Captures the bracketed dims; "f32[]" is a scalar.
+_HLO_SHAPE_RE = re.compile(r"\w+\[([\d,]*)\]")
+
+
+def hlo_result_elements(shape_str: str) -> int:
+    """Total elements across every array in an HLO result shape string
+    (async collectives return tuples; sum the parts so `-start` forms
+    compare like their sync equivalents)."""
+    total = 0
+    for m in _HLO_SHAPE_RE.finditer(shape_str):
+        dims = m.group(1)
+        if not dims:
+            total += 1  # scalar
+            continue
+        n = 1
+        for d in dims.split(","):
+            n *= int(d)
+        total += n
+    return total
+
+
+def collective_census(compiled_text: str) -> List[dict]:
+    """Census of collective ops in optimized HLO text: op kind + result shape.
+
+    The static half of the grad-sync analysis: what the compiler actually
+    scheduled (names/shapes straight from the executable), standing in for
+    the reference's promised profiler-timeline read-off (README.md:35)."""
+    rows = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(compiled_text):
+        shape, kind, suffix = m.group(1), m.group(2), m.group(3)
+        if suffix == "-done":
+            continue  # the paired completion of an async -start
+        key = (kind, shape)
+        if key not in rows:
+            rows[key] = {"op": kind, "result_shape": shape, "count": 0}
+        rows[key]["count"] += 1
+    return sorted(rows.values(), key=lambda r: (r["op"], r["result_shape"]))
+
+
+def weight_update_census(compiled_text: str, min_elements: int = 8192) -> dict:
+    """The gradient-sync subset of the census: collectives whose result
+    carries at least `min_elements` elements — gradient- and parameter-sized
+    transfers. Scalar psums (metric fan-in, global-norm clipping, BatchNorm
+    channel stats) fall under the floor, so the returned counts isolate the
+    ops that move the model: the DDP-style grad all-reduce on the replicated
+    path, reduce-scatter + all-gather on the zero1 path.
+
+    Returns {"all-reduce": n, "reduce-scatter": n, "all-gather": n,
+    "rows": [...]} (other collective kinds appear only if present)."""
+    counts: Dict[str, int] = {"all-reduce": 0, "reduce-scatter": 0,
+                              "all-gather": 0}
+    rows = []
+    for c in collective_census(compiled_text):
+        if hlo_result_elements(c["result_shape"]) < min_elements:
+            continue
+        counts[c["op"]] = counts.get(c["op"], 0) + c["count"]
+        rows.append(c)
+    counts["rows"] = rows
+    return counts
+
+
+def verify_zero1_collectives(replicated_text: str, zero1_text: str,
+                             min_elements: int = 8192) -> dict:
+    """The acceptance check for the zero1 mode (ISSUE 1): in the compiled
+    zero1 step, gradient-sized all-reduces are REPLACED by reduce-scatter +
+    all-gather. Returns the two weight-update censuses plus a verdict dict;
+    raises AssertionError naming the offending ops when the replacement did
+    not happen (a silent fallback to all-reduce would erase the win while
+    the flag still claims it)."""
+    rep = weight_update_census(replicated_text, min_elements)
+    z1 = weight_update_census(zero1_text, min_elements)
+    if rep["all-reduce"] == 0:
+        raise AssertionError(
+            "replicated step shows no gradient-sized all-reduce — the "
+            f"census floor ({min_elements} elements) is above the model's "
+            "gradient transfers; lower min_elements")
+    problems = []
+    if z1["all-reduce"]:
+        problems.append(
+            f"zero1 step still contains {z1['all-reduce']} gradient-sized "
+            f"all-reduce(s): {[r for r in z1['rows'] if r['op'] == 'all-reduce']}")
+    if not z1["reduce-scatter"]:
+        problems.append("zero1 step contains no reduce-scatter")
+    if not z1["all-gather"]:
+        problems.append("zero1 step contains no all-gather")
+    if problems:
+        raise AssertionError("; ".join(problems))
+    return {"replicated": rep, "zero1": z1}
 
 
 def capture_step_trace(step_fn, state, batch, key, log_dir: str,

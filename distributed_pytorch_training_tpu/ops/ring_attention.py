@@ -50,6 +50,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, MODEL, SEQ
+from ..parallel.collectives import shard_map
 
 NEG_INF = float(np.finfo(np.float32).min)
 
@@ -319,8 +320,8 @@ def ring_attention(
         body = functools.partial(_ring_body, axis_name=axis_name,
                                  causal=causal, sm_scale=scale,
                                  q_chunk=q_chunk)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
 
 
 def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ,

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import BATCH_AXES, MODEL, SEQ
+from ..parallel.collectives import shard_map
 
 NEG_INF = float(np.finfo(np.float32).min)
 
@@ -98,8 +99,8 @@ def ulysses_attention(
                               tiled=True)
 
     spec = P(BATCH_AXES, axis_name, MODEL, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
 
 
 def make_ulysses_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ):

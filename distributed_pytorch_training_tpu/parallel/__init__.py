@@ -19,6 +19,7 @@ from .mesh import (  # noqa: F401
     local_batch_size,
 )
 from .collectives import (  # noqa: F401
+    all_gather,
     all_to_all,
     barrier,
     broadcast_from_main,
@@ -27,7 +28,9 @@ from .collectives import (  # noqa: F401
     pmean,
     ppermute_ring,
     psum,
+    psum_scatter,
     reduce_scalar,
+    shard_map,
 )
 from .sharding import (  # noqa: F401
     PartitionRules,

@@ -16,9 +16,12 @@ The reference touches NCCL in four ways (/root/reference/train_ddp.py):
 
 Two distinct layers, never to be confused:
 
-* **In-program collectives** (`psum`, `pmean`, `pmax`, `ppermute_ring`,
-  `all_to_all`): used inside `shard_map`-ped functions where mesh axis names
-  are bound. These lower to XLA collectives riding ICI.
+* **In-program collectives** (`psum`, `pmean`, `pmax`, `psum_scatter`,
+  `all_gather`, `ppermute_ring`, `all_to_all`): used inside `shard_map`-ped
+  functions where mesh axis names are bound. These lower to XLA collectives
+  riding ICI. `psum_scatter`/`all_gather` are the two halves of an
+  all-reduce, split so the ZeRO-1 weight update (training/loop.py) can do
+  per-replica work between them.
 * **Host-level collectives** (`barrier`, `broadcast_from_main`,
   `host_all_gather`, `reduce_scalar`): process-level synchronization across
   hosts, used for data-download gating (ref :111-112) and metric fan-in.
@@ -26,7 +29,8 @@ Two distinct layers, never to be confused:
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+import inspect
+from typing import Any, Callable, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +39,30 @@ from jax import lax
 from jax.sharding import Mesh
 
 AxisName = Union[str, Sequence[str]]
+
+try:  # jax >= 0.6 exposes shard_map at the top level
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # older jax keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def shard_map(f: Callable, mesh: Mesh, in_specs: Any, out_specs: Any):
+    """`jax.shard_map` across jax versions, with replication checking off.
+
+    One compat point for every shard_map in the repo: the entry point moved
+    (experimental -> top level) and the check flag was renamed
+    (``check_rep`` -> ``check_vma``) across the jax versions this code runs
+    under. Checking is disabled because the bodies here use collectives
+    whose replication the checker cannot always prove (psum_scatter /
+    all_gather chains)."""
+    params = inspect.signature(_shard_map_impl).parameters
+    kwargs = {}
+    if "check_vma" in params:
+        kwargs["check_vma"] = False
+    elif "check_rep" in params:
+        kwargs["check_rep"] = False
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)
 
 
 def _axes_present(axis_name: AxisName, mesh: Optional[Mesh]) -> bool:
@@ -78,12 +106,46 @@ def pmax(x: Any, axis_name: AxisName, *, mesh: Optional[Mesh] = None) -> Any:
     return lax.pmax(x, axis_name)
 
 
+def psum_scatter(x: Any, axis_name: AxisName, *, scatter_dimension: int = 0,
+                 tiled: bool = True, mesh: Optional[Mesh] = None) -> Any:
+    """SUM-reduce across the axes, each replica keeping only ITS chunk of the
+    result — the first half of an all-reduce (all-reduce = reduce-scatter +
+    all-gather), and the gradient-sync primitive of the ZeRO-1 sharded
+    weight update (Xu et al., PAPERS.md): every replica receives 1/N of the
+    synchronized gradient instead of all of it.
+
+    Identity when the axes are trivial (reducing over one replica and
+    keeping its single chunk is the value itself) — the same single-device
+    passthrough convention as `psum` (ref train_ddp.py:164-165).
+    """
+    if not _axes_present(axis_name, mesh):
+        return x
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_gather(x: Any, axis_name: AxisName, *, axis: int = 0,
+               tiled: bool = True, mesh: Optional[Mesh] = None) -> Any:
+    """Concatenate every replica's chunk along `axis` — the second half of an
+    all-reduce, and the ZeRO-1 weight-update epilogue (each replica gathers
+    the 1/N of the new parameters every other replica just updated).
+
+    Identity when the axes are trivial, like `psum`/`psum_scatter`.
+    """
+    if not _axes_present(axis_name, mesh):
+        return x
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
 def ppermute_ring(x: Any, axis_name: str, *, shift: int = 1) -> Any:
     """Rotate `x` around the ring of `axis_name` — the building block of ring
     attention (KV blocks circulate over the ICI ring). No NCCL analogue in the
     reference (max sequence there is a 32x32 image); this is the long-context
     primitive SURVEY.md §5 requires."""
-    n = lax.axis_size(axis_name)
+    if hasattr(lax, "axis_size"):
+        n = lax.axis_size(axis_name)
+    else:  # older jax: psum of a Python literal constant-folds to the size
+        n = int(lax.psum(1, axis_name))
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import BATCH_AXES, PIPE
+from .collectives import shard_map
 from .sharding import batch_spec
 
 
@@ -140,8 +141,8 @@ def pipeline_apply(
                         PIPE)
         return outs.reshape(b, *xs.shape[1:])
 
-    return jax.shard_map(spmd, mesh=mesh, in_specs=(p_spec, x_spec),
-                         out_specs=x_spec, check_vma=False)(stage_params, x)
+    return shard_map(spmd, mesh=mesh, in_specs=(p_spec, x_spec),
+                         out_specs=x_spec)(stage_params, x)
 
 
 def sequential_apply(apply_layer: Callable, stacked_params: Any,

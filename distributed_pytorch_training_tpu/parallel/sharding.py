@@ -160,6 +160,41 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-1 flat-shard layout (Xu et al., "Automatic Cross-Replica Sharding of
+# Weight Update in Data-Parallel Training", PAPERS.md).
+#
+# The sharded weight update partitions every parameter's *flattened* value
+# over the data-parallel axes: tensor shapes never constrain divisibility
+# (a (1000,) bias on 8 replicas pads 1000 -> 1008 and shards 126 elements
+# per replica), and the optimizer update becomes shape-agnostic elementwise
+# work on (padded_size / N,) chunks. Padding elements carry zero gradient,
+# so they stay zero through any elementwise optimizer chain.
+# ---------------------------------------------------------------------------
+
+
+def flat_padded_size(size: int, n_shards: int) -> int:
+    """`size` rounded up to a multiple of `n_shards` (0-padding at the end)."""
+    return size + (-size % n_shards)
+
+
+def flatten_pad(x, n_shards: int):
+    """1-D view of `x`, zero-padded so it splits evenly into `n_shards`."""
+    import jax.numpy as jnp
+
+    flat = jnp.ravel(x)
+    pad = -flat.size % n_shards
+    return jnp.pad(flat, (0, pad)) if pad else flat
+
+
+def dp_flat_specs(tree: Any, axes: Sequence[str] = BATCH_AXES) -> Any:
+    """Spec tree for a ZeRO-1 flat-sharded pytree: every array leaf is 1-D
+    and sharded over the data-parallel axes; scalars (optimizer step counts)
+    stay replicated."""
+    return jax.tree_util.tree_map(
+        lambda leaf: P(tuple(axes)) if np.ndim(leaf) else P(), tree)
+
+
 def batch_spec(ndim: int = 1) -> P:
     """Leading dim sharded over the batch axes (data, fsdp); rest replicated.
 

@@ -25,9 +25,15 @@ from typing import Any, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.sharding import PartitionRules, shard_pytree
+from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
+from ..parallel.mesh import BATCH_AXES, batch_shard_count
+from ..parallel.sharding import (
+    PartitionRules, batch_spec, dp_flat_specs, flatten_pad, shard_pytree,
+)
 from ..utils.logging import log_main
 from ..utils.metrics import ThroughputMeter
 from .tasks import Task, add_metrics, summarize, zero_metrics
@@ -48,6 +54,14 @@ class TrainConfig:
     # gradients — reference-scale global batches on few chips at
     # 1/grad_accum the activation memory. 1 = off.
     grad_accum: int = 1
+    # ZeRO-1 cross-replica weight-update sharding (Xu et al., PAPERS.md):
+    # gradients reduce-scatter over the data-parallel axes instead of
+    # all-reducing, each replica updates 1/N of the (flattened) parameters
+    # with 1/N of the optimizer state, and the new parameters all-gather
+    # back to replicated — optimizer compute and moment memory divided by
+    # the DP degree. Off = the replicated (DDP-equivalent) update. No-op on
+    # a single batch shard (the collectives' passthrough convention).
+    zero1: bool = False
 
 
 class Trainer:
@@ -69,6 +83,32 @@ class Trainer:
         self._flops_per_sample: Optional[float] = None
         self._peak_flops_total: Optional[float] = None
 
+        self._zero1_n = batch_shard_count(mesh)
+        self._zero1 = bool(config.zero1) and self._zero1_n > 1
+        if config.zero1:
+            bad = sorted(a for a, s in mesh.shape.items()
+                         if s > 1 and a not in BATCH_AXES)
+            if bad:
+                raise ValueError(
+                    f"zero1 shards the weight update over the data-parallel "
+                    f"axes {BATCH_AXES}; mesh axes {bad} > 1 need the "
+                    "replicated update path (TP/SP/PP/EP collectives are "
+                    "per-layer, not per-update)")
+            if rules is not None:
+                conflict = sorted(
+                    rules.axes_used()
+                    & {a for a in BATCH_AXES if mesh.shape[a] > 1})
+                if conflict:
+                    raise ValueError(
+                        f"zero1 assumes replicated parameters, but the "
+                        f"partition rules shard params over {conflict} — "
+                        "use either zero1 (optimizer-state sharding) or "
+                        "fsdp parameter sharding on this mesh, not both")
+            if not self._zero1:
+                log_main("NOTE: zero1 requested on a single batch shard — "
+                         "running the replicated update (identity "
+                         "passthrough, like single-process DDP)")
+
         donate = (0,) if config.donate_state else ()
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=donate)
         self._eval_step = jax.jit(self._eval_step_impl)
@@ -88,6 +128,9 @@ class Trainer:
     def _train_step_impl(self, state: TrainState, batch, epoch_key):
         rng = jax.random.fold_in(epoch_key, state.step)
         accum = self.config.grad_accum
+
+        if self._zero1:
+            return self._zero1_step(state, batch, rng)
 
         if accum <= 1:
             def loss_fn(params):
@@ -190,6 +233,163 @@ class Trainer:
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
         return new_state, metrics
 
+    # -- ZeRO-1 sharded weight update ---------------------------------------
+
+    def _zero1_step(self, state: TrainState, batch, rng):
+        """Cross-replica sharded update (Xu et al., PAPERS.md): the whole
+        step runs in a shard_map over the batch axes, so the gradient sync
+        is an explicit `psum_scatter` (a true reduce-scatter in the compiled
+        HLO — half an all-reduce), the optimizer update touches only this
+        replica's 1/N flat chunk of params + moments, and one `all_gather`
+        rebuilds the replicated parameters. Collective payload per step
+        stays ~2x params (all-reduce = reduce-scatter + all-gather), but
+        the update compute and moment memory divide by N, and XLA can
+        overlap the gather with the next step's forward.
+
+        Semantics vs the replicated path, same batch:
+        * deterministic tasks (causal LM, dropout 0): identical up to fp
+          reassociation — the parity contract tests/test_zero1.py pins;
+        * stochastic tasks: each shard folds its linear shard index into
+          the step RNG, so draws are independent across shards but differ
+          from the replicated path's single global stream (the grad-accum
+          caveat, verbatim);
+        * BatchNorm models: each shard normalizes by ITS OWN statistics —
+          exactly torch DDP's per-GPU BatchNorm (ref train_ddp.py:305-310
+          never syncs BN), where the replicated GSPMD path computes
+          global-batch statistics. EMAs stay unbiased: the weighted mean
+          of per-shard EMAs equals one EMA update with the weighted-mean
+          batch statistics (the grad-accum argument, across space instead
+          of time).
+        """
+        mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
+        axes = BATCH_AXES
+        task = self.task
+        has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        outer = state  # static fields (apply_fn/tx) for the inner rebuild
+
+        rep = P()
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: batch_spec(jnp.ndim(x)), batch)
+        opt_specs = dp_flat_specs(state.opt_state)
+
+        def body(params, opt_state, stats, lbatch, key, step):
+            inner = outer.replace(step=step, params=params,
+                                  batch_stats=stats, opt_state=opt_state)
+            idx = lax.axis_index(axes)  # linear replica index over the axes
+
+            def micro_grads(mb, k):
+                def loss_fn(p):
+                    return task.loss_and_metrics(inner, p, mb, k, train=True)
+
+                return jax.grad(loss_fn, has_aux=True)(params)
+
+            def scatter(a):
+                # this replica's 1/N chunk of the cross-replica gradient sum
+                return psum_scatter(flatten_pad(a, n), axes)
+
+            if accum <= 1:
+                key = jax.random.fold_in(key, idx)
+                g, (m, stats_l) = micro_grads(lbatch, key)
+                w = m["weight"]
+                g_sum = jax.tree_util.tree_map(
+                    lambda a: scatter(w * a.astype(jnp.float32)), g)
+                s_sum = (jax.tree_util.tree_map(
+                    lambda s: w * s.astype(jnp.float32), stats_l)
+                    if has_stats else stats)
+                m_local = m
+            else:
+                # grad accumulation INSIDE the sharded step: the scan carry
+                # holds w-scaled gradient *shards* ((padded/N,) fp32), so
+                # the accumulation buffer is 1/N the replicated path's.
+                # Split is over the LOCAL rows; with the local batch
+                # divisible by accum, local rows i::accum are exactly the
+                # shard's part of global microbatch i (the interleaved
+                # global split of the replicated path).
+                def split(x):
+                    if x.ndim == 0:
+                        return jnp.broadcast_to(x, (accum,))
+                    if x.shape[0] % accum:
+                        raise ValueError(
+                            f"per-shard batch {x.shape[0]} not divisible "
+                            f"by grad_accum={accum}")
+                    return x.reshape(x.shape[0] // accum, accum,
+                                     *x.shape[1:]).swapaxes(0, 1)
+
+                micro_batches = jax.tree_util.tree_map(split, lbatch)
+                keys = jax.random.split(key, accum)
+
+                def mb_body(carry, xs):
+                    g_sum, s_sum, m_sum = carry
+                    mb, k = xs
+                    g, (m, stats_mb) = micro_grads(
+                        mb, jax.random.fold_in(k, idx))
+                    w = m["weight"]
+                    g_sum = jax.tree_util.tree_map(
+                        lambda a, b: a + scatter(w * b.astype(a.dtype)),
+                        g_sum, g)
+                    if has_stats:
+                        s_sum = jax.tree_util.tree_map(
+                            lambda a, b: a + w * b.astype(a.dtype),
+                            s_sum, stats_mb)
+                    m_sum = add_metrics(m_sum, m)
+                    return (g_sum, s_sum, m_sum), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        (flatten_pad(p, n).size // n,), jnp.float32),
+                    params)
+                s0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), stats)
+                (g_sum, s_sum, m_local), _ = lax.scan(
+                    mb_body, (g0, s0, zero_metrics()),
+                    (micro_batches, keys))
+
+            # fan the per-shard metric sums in (the reference's 3 epoch
+            # all-reduces, ref :251-253, here 3 scalar psums per step)
+            metrics = jax.tree_util.tree_map(
+                lambda v: psum(v, axes), m_local)
+            total_w = jnp.maximum(metrics["weight"], 1.0)
+
+            def pshard(p):
+                flat = flatten_pad(p, n)
+                k = flat.size // n
+                return lax.dynamic_slice_in_dim(flat, idx * k, k)
+
+            p_shards = jax.tree_util.tree_map(pshard, params)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / total_w).astype(p.dtype), g_sum, p_shards)
+
+            # 1/N of the optimizer update — the whole point of zero1
+            updates, new_opt = outer.tx.update(grads, opt_state, p_shards)
+            new_p_shards = optax.apply_updates(p_shards, updates)
+            new_params = jax.tree_util.tree_map(
+                lambda s, p: all_gather(s, axes)[:p.size].reshape(p.shape),
+                new_p_shards, params)
+
+            if has_stats:
+                # A fully-padded global batch (weight 0) keeps old stats
+                # (grads are a no-op then), mirroring the accum path.
+                new_stats = jax.tree_util.tree_map(
+                    lambda s, old: jnp.where(
+                        metrics["weight"] > 0,
+                        psum(s, axes) / total_w,
+                        old.astype(jnp.float32)).astype(old.dtype),
+                    s_sum, stats)
+            else:
+                new_stats = stats
+            return new_params, new_opt, new_stats, metrics
+
+        stepped = shard_map(
+            body, mesh,
+            in_specs=(rep, opt_specs, rep, batch_specs, rep, rep),
+            out_specs=(rep, opt_specs, rep, rep))
+        new_params, new_opt, new_stats, metrics = stepped(
+            state.params, state.opt_state, state.batch_stats, batch, rng,
+            state.step)
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats, opt_state=new_opt)
+        return new_state, metrics
+
     def _eval_step_impl(self, state: TrainState, batch):
         rng = jax.random.PRNGKey(0)  # unused: eval has no augmentation (ref :98-101)
         _, (metrics, _) = self.task.loss_and_metrics(
@@ -214,6 +414,19 @@ class Trainer:
         variables = model.init(init_rng, x, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
+        if self._zero1:
+            # Params stay replicated (the DDP layout — zero1 shards only
+            # the UPDATE); the optimizer state is born flat-padded-sharded
+            # over the batch axes, 1/N per replica.
+            from .optim import zero1_opt_state
+
+            opt_state = zero1_opt_state(tx, params, self.mesh)
+            state = TrainState.create(
+                apply_fn=model.apply, params=params, tx=tx,
+                batch_stats=batch_stats, opt_state=opt_state)
+            placed = shard_pytree(state.replace(opt_state={}), self.mesh,
+                                  self.rules)
+            return placed.replace(opt_state=opt_state)
         state = TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, batch_stats=batch_stats)
         return shard_pytree(state, self.mesh, self.rules)

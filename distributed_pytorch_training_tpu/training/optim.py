@@ -11,11 +11,50 @@ the reference step-for-step in fp32.
 
 from __future__ import annotations
 
-from typing import Optional, Union
+from typing import Optional, Sequence, Union
 
 import optax
 
 Schedule = Union[float, optax.Schedule]
+
+
+def clip_by_global_norm_dp(
+    max_norm: float, axis_names: Optional[Sequence[str]] = None,
+) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` whose norm is psum'd over mesh axes.
+
+    The ZeRO-1 sharded update (training/loop.py) feeds the optimizer
+    per-replica SHARDS of the global gradient; the stock clip would then
+    clip each replica by its own shard's norm — a different (and per-replica
+    inconsistent) trajectory. Summing the squared norms across `axis_names`
+    first recovers the exact global norm, so zero1 and replicated runs clip
+    identically. With ``axis_names=None`` this IS the stock transform (the
+    single-device passthrough convention of parallel/collectives.py).
+    Usable only inside a context that binds the axis names (shard_map).
+    """
+    if not axis_names:
+        return optax.clip_by_global_norm(max_norm)
+
+    import jax
+    import jax.numpy as jnp
+
+    def update_fn(updates, state, params=None):
+        del params
+        sq = sum(jnp.sum(jnp.square(u))
+                 for u in jax.tree_util.tree_leaves(updates))
+        g_norm = jnp.sqrt(jax.lax.psum(sq, tuple(axis_names)))
+        # mirror optax.clip_by_global_norm exactly (select, not clamp) so
+        # the parity with the replicated path is bit-for-bit in fp32
+        trigger = jnp.squeeze(g_norm < max_norm)
+
+        def clip_fn(t):
+            return jax.lax.select(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm)
+
+        return jax.tree_util.tree_map(clip_fn, updates), state
+
+    return optax.GradientTransformation(
+        lambda params: optax.EmptyState(), update_fn)
 
 
 def make_schedule(
@@ -67,12 +106,19 @@ def adamw(
     eps: float = 1e-8,
     weight_decay: float = 0.01,
     grad_clip_norm: Optional[float] = 1.0,
+    shard_axes: Optional[Sequence[str]] = None,
 ) -> optax.GradientTransformation:
     """AdamW for BERT/GPT-2 (BASELINE.json:11-12); decoupled weight decay,
-    optional global-norm clipping (standard for LM training)."""
+    optional global-norm clipping (standard for LM training).
+
+    ``shard_axes``: mesh axis names the ZeRO-1 update shards gradients over
+    — the clip's global norm is then psum'd across them (every other part of
+    the chain is elementwise and shard-oblivious). Leave None for the
+    replicated path.
+    """
     parts = []
     if grad_clip_norm:
-        parts.append(optax.clip_by_global_norm(grad_clip_norm))
+        parts.append(clip_by_global_norm_dp(grad_clip_norm, shard_axes))
     parts.append(optax.scale_by_adam(b1=b1, b2=b2, eps=eps))
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))
@@ -86,12 +132,40 @@ def make_optimizer(
     momentum: float = 0.9,
     weight_decay: float = 5e-4,
     grad_clip_norm: Optional[float] = None,
+    shard_axes: Optional[Sequence[str]] = None,
 ) -> optax.GradientTransformation:
     """Optimizer factory keyed by CLI name (the reference hardcodes SGD,
-    ref :339; transformers need AdamW)."""
+    ref :339; transformers need AdamW). ``shard_axes`` — see `adamw`; SGD's
+    chain is fully elementwise, so it needs no shard awareness."""
     if name == "sgd":
         return sgd(learning_rate, momentum=momentum, weight_decay=weight_decay)
     if name == "adamw":
         return adamw(learning_rate, weight_decay=weight_decay,
-                     grad_clip_norm=grad_clip_norm)
+                     grad_clip_norm=grad_clip_norm, shard_axes=shard_axes)
     raise ValueError(f"unknown optimizer {name!r} (sgd, adamw)")
+
+
+def zero1_opt_state(tx: optax.GradientTransformation, params,
+                    mesh) -> "tuple":
+    """Optimizer state for the ZeRO-1 sharded update: moments are born in
+    the flat-padded-sharded layout (parallel/sharding.py `flatten_pad`),
+    each replica materializing ONLY its 1/N chunk — the optimizer-memory
+    division that motivates cross-replica weight-update sharding (Xu et
+    al., PAPERS.md). Scalar state (step counts) stays replicated.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..parallel.mesh import batch_shard_count
+    from ..parallel.sharding import dp_flat_specs, flatten_pad
+
+    n = batch_shard_count(mesh)
+
+    def init(params):
+        flat = jax.tree_util.tree_map(lambda p: flatten_pad(p, n), params)
+        return tx.init(flat)
+
+    specs = dp_flat_specs(jax.eval_shape(init, params))
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs)
+    return jax.jit(init, out_shardings=shardings)(params)

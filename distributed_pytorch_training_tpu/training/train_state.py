@@ -26,12 +26,19 @@ class TrainState:
 
     @classmethod
     def create(cls, apply_fn: Callable, params: Any, tx: optax.GradientTransformation,
-               batch_stats: Any = None) -> "TrainState":
+               batch_stats: Any = None, opt_state: Any = None) -> "TrainState":
+        """``opt_state`` overrides the default ``tx.init(params)`` — the
+        ZeRO-1 path (training/loop.py) constructs its optimizer state in the
+        flat-padded-sharded layout (optim.zero1_opt_state), where every
+        moment leaf is a 1-D chunk of the flattened parameter partitioned
+        across the data-parallel replicas rather than a replicated copy.
+        Checkpointing is layout-agnostic either way: orbax restores into
+        whatever sharded template the run constructs (checkpoint.py)."""
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats if batch_stats is not None else {},
-            opt_state=tx.init(params),
+            opt_state=tx.init(params) if opt_state is None else opt_state,
             apply_fn=apply_fn,
             tx=tx,
         )

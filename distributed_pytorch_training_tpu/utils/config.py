@@ -83,6 +83,13 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="gradient accumulation: microbatches per "
                              "optimizer step inside the jitted step "
                              "(reference-scale global batches on few chips)")
+    parser.add_argument("--zero1", action="store_true",
+                        help="ZeRO-1 cross-replica weight-update sharding "
+                             "for data-parallel meshes: reduce-scatter "
+                             "gradients, update 1/N of the params + "
+                             "optimizer state per replica, all-gather the "
+                             "new params — optimizer compute/memory / N. "
+                             "Default off (replicated DDP-style update)")
     parser.add_argument("--remat", action="store_true",
                         help="gradient checkpointing: recompute each "
                              "transformer block in the backward pass "

@@ -20,7 +20,12 @@ import pytest  # noqa: E402
 # The image's sitecustomize imports jax before this conftest runs, so jax's
 # config has already captured JAX_PLATFORMS=axon — override via the config API.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax has no jax_num_cpu_devices option; the XLA_FLAGS fallback
+    # above provides the 8 virtual devices there.
+    pass
 
 
 @pytest.fixture(scope="session")

@@ -340,15 +340,28 @@ def test_relay_deathwatch_aborts_inner_when_tunnel_dies(tmp_path):
 
     def accept_forever(s):
         # a real relay accepts; without this the watch's liveness probes
-        # fill the backlog and the STAYING port would read as down too
+        # fill the backlog and the port would read as down too early.
+        # Timeout-polling accept (not a blocking accept): a thread blocked
+        # in kernel accept() pins the socket open past close(), so the
+        # deliberate close would not actually stop the port listening.
+        s.settimeout(0.2)
         while True:
             try:
                 conn, _ = s.accept()
                 conn.close()
+            except socket.timeout:
+                continue
             except OSError:
                 return
 
     import threading
+    # BOTH listeners run accept loops: srv_dies must read as alive right up
+    # to its deliberate close, or the watch's own probes fill its backlog(8)
+    # and trip the deathwatch before the alive-then-dies transition the test
+    # exists to exercise (ADVICE r5 #4). The loop thread ends when close()
+    # invalidates the fd (accept raises OSError).
+    threading.Thread(target=accept_forever, args=(srv_dies,),
+                     daemon=True).start()
     threading.Thread(target=accept_forever, args=(srv_stays,),
                      daemon=True).start()
     env = dict(os.environ)

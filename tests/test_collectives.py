@@ -9,13 +9,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from distributed_pytorch_training_tpu.parallel import (
     MeshSpec,
     build_mesh,
     collectives as cc,
 )
+from distributed_pytorch_training_tpu.parallel.collectives import shard_map
 from distributed_pytorch_training_tpu.parallel.mesh import DATA, SEQ
 
 
@@ -72,6 +72,46 @@ def test_all_to_all_transposes_shards(devices):
     # and the values landed back in place.
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
     assert out.addressable_shards[0].data.shape == (8, 1)
+
+
+def test_psum_scatter_all_gather_compose_to_psum(mesh8):
+    """reduce-scatter + all-gather IS an all-reduce: gathering the scattered
+    chunks must reproduce psum exactly — the identity the ZeRO-1 update is
+    built on (each replica updates its chunk between the two halves)."""
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(x):
+        full = cc.psum(x, DATA, mesh=mesh8)          # (1, 8) rows summed
+        chunk = cc.psum_scatter(x[0], DATA)           # this replica's 1/8
+        regathered = cc.all_gather(chunk, DATA)       # back to the full sum
+        return jnp.abs(regathered - full[0]).max()
+
+    out = shard_map(body, mesh=mesh8, in_specs=P(DATA), out_specs=P())(x)
+    assert float(out) == 0.0
+
+
+def test_psum_scatter_chunk_ownership(mesh8):
+    """Replica i's psum_scatter output is chunk i of the summed vector, in
+    axis-index order — the ordering all_gather inverts (and the parameter-
+    shard ownership rule of the zero1 update)."""
+    x = jnp.ones((8, 8))
+
+    def body(x):
+        chunk = cc.psum_scatter(jnp.arange(8.0) * x[0], DATA)
+        # every replica contributed [0..7], so chunk i = (8 * i,)
+        idx = jax.lax.axis_index(DATA)
+        return jnp.abs(chunk - 8.0 * idx).max()
+
+    out = shard_map(body, mesh=mesh8, in_specs=P(DATA), out_specs=P())(x)
+    assert float(out) == 0.0
+
+
+def test_psum_scatter_and_all_gather_passthrough_on_trivial_axis(mesh8):
+    # single-device convention: reduce over one replica keeping its one
+    # chunk (and gathering one chunk) is the identity, at trace time
+    x = jnp.arange(4.0)
+    assert cc.psum_scatter(x, "model", mesh=mesh8) is x
+    assert cc.all_gather(x, "model", mesh=mesh8) is x
 
 
 def test_host_collectives_single_process():

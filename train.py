@@ -339,8 +339,15 @@ def _run(args, guard):
     schedule = make_schedule(args.schedule, args.lr,
                              total_steps=steps_per_epoch * args.epochs,
                              warmup_steps=args.warmup_steps)
+    from distributed_pytorch_training_tpu.parallel.mesh import BATCH_AXES
+
+    # zero1 on a single batch shard runs the replicated (non-shard_map)
+    # update, where a shard-axes psum would hit unbound axis names — the
+    # clip's shard awareness must follow the same passthrough condition.
+    zero1_sharded = args.zero1 and n_batch_shards > 1
     tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
-                        weight_decay=args.weight_decay)
+                        weight_decay=args.weight_decay,
+                        shard_axes=BATCH_AXES if zero1_sharded else None)
 
     rules = (type(model).partition_rules()
              if hasattr(type(model), "partition_rules") else None)
@@ -353,8 +360,13 @@ def _run(args, guard):
     trainer = Trainer(task, mesh,
                       TrainConfig(per_device_batch=args.batch_size,
                                   print_freq=args.print_freq, seed=args.seed,
-                                  bf16=args.amp, grad_accum=args.grad_accum),
+                                  bf16=args.amp, grad_accum=args.grad_accum,
+                                  zero1=args.zero1),
                       rules=rules)
+    if args.zero1 and n_batch_shards > 1:
+        log_main(f"ZeRO-1: weight update sharded {n_batch_shards}-way over "
+                 "the batch axes (reduce-scatter grads -> 1/N optimizer "
+                 "update -> all-gather params)")
 
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
@@ -403,8 +415,10 @@ def _run(args, guard):
                 # lcm(128, model-axis)): resuming under a different --mesh
                 # builds a mismatched template and orbax fails opaquely.
                 # Diagnose precisely from the saved shape metadata.
-                hint = ("resume with the SAME --mesh (the vocab padding "
-                        "for TP follows the model axis)")
+                hint = ("resume with the SAME --mesh and --zero1 setting "
+                        "(vocab padding for TP follows the model axis; "
+                        "zero1 stores optimizer state flat-sharded, the "
+                        "replicated path stores it param-shaped)")
                 try:
                     meta = ckpt.latest_metadata()
                     saved_params = meta["params"] if meta else {}
