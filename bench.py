@@ -96,6 +96,19 @@ EXTRA_CONFIGS = (
           zero1=True)),
     ("gpt2_124m_zero1", "gpt2_124m", 400,
      dict(per_device_batch=8, seq_len=1024, steps=10, zero1=True)),
+    # Explicit bucketed/compressed gradient sync (training/loop.py
+    # bucket_cap_mb / wire_dtype; parallel/grad_sync.py): on one chip the
+    # reducer is an identity passthrough (regression canary, like the
+    # zero1 arms); on multi-chip meshes these rows carry the bucket census
+    # + exposed-comm fraction, the overlap-efficiency numbers BENCH_*
+    # history tracks across PRs (experiments/scaling.py `grad_sync` is the
+    # full instrumented arm)
+    ("resnet18_gsync", "resnet18", 420,
+     dict(per_device_batch=4096, image_hw=32, num_classes=10, steps=20,
+          grad_sync=dict(bucket_cap_mb=25.0))),
+    ("gpt2_124m_gsync_bf16", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10,
+          grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="bf16"))),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can
@@ -915,6 +928,10 @@ def _bench(args):
     def run(name, **kw):
         _log(f"bench: === {name} {kw} === ({time_left():.0f}s left)")
         t0 = time.perf_counter()
+        # exposed-comm split only where collectives exist (>1 chip); the
+        # capture is try/except'd inside measure_config — a failed trace
+        # never fails a bench row
+        kw.setdefault("comm_trace", n_chips > 1)
         try:
             r = measure_config(name, repeats=args.repeats, **kw)
         except MeasurementError as e:

@@ -29,10 +29,13 @@ import numpy as np
 
 def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
                         model_name: str = "resnet18", image_hw: int = 32,
-                        num_classes: int = 10, zero1: bool = False):
+                        num_classes: int = 10, zero1: bool = False,
+                        grad_sync: Optional[dict] = None):
     """(trainer, state, mesh) for an image-classification config on a pure-DP
     mesh over `devices` (the benchmark workload, BASELINE.json:8).
-    ``zero1`` switches the trainer to the sharded weight update."""
+    ``zero1`` switches the trainer to the sharded weight update;
+    ``grad_sync`` holds TrainConfig overrides for the explicit reducer
+    (bucket_cap_mb / wire_dtype / overlap_grad_sync / grad_accum)."""
     from ..data import CIFAR10_MEAN, CIFAR10_STD
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
@@ -46,7 +49,8 @@ def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
     task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
                                    augment=True, compute_dtype=dtype)
     trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16,
-                                              zero1=zero1))
+                                              zero1=zero1,
+                                              **(grad_sync or {})))
     state = trainer.init_state(
         model, np.zeros((1, image_hw, image_hw, 3), np.float32),
         sgd(0.1, momentum=0.9, weight_decay=5e-4), jax.random.PRNGKey(0))
@@ -65,11 +69,13 @@ def lm_vocab(model_name: str) -> int:
 def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
                      model_name: str, seq_len: int,
                      model_kwargs: Optional[dict] = None,
-                     zero1: bool = False):
+                     zero1: bool = False,
+                     grad_sync: Optional[dict] = None):
     """(trainer, state, mesh) for a language-model config (gpt2_*/bert_base,
     BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes.
     `model_kwargs` overrides architecture fields (CI smoke runs shrink the
-    model; benchmarks use the real sizes)."""
+    model; benchmarks use the real sizes). ``grad_sync`` — see
+    `build_image_trainer`."""
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
     from ..training import TrainConfig, Trainer
@@ -110,7 +116,8 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
     from ..parallel.mesh import BATCH_AXES, batch_shard_count
 
     trainer = Trainer(task, mesh, TrainConfig(seed=0, bf16=bf16,
-                                              zero1=zero1),
+                                              zero1=zero1,
+                                              **(grad_sync or {})),
                       rules=type(model).partition_rules())
     # zero1 shards the update; the AdamW global-norm clip must psum across
     # the shards or each replica clips by its own shard's norm (optim.py).
@@ -128,14 +135,17 @@ def build_trainer(devices: Sequence[jax.Device], bf16: bool, model_name: str,
                   seq_len: int = 512, image_hw: int = 32,
                   num_classes: int = 10,
                   lm_overrides: Optional[dict] = None,
-                  zero1: bool = False):
+                  zero1: bool = False,
+                  grad_sync: Optional[dict] = None):
     """Model-family dispatch used by bench.py AND the experiment drivers —
     the same `--model` string must measure the same config everywhere."""
     if is_lm_model(model_name):
         return build_lm_trainer(devices, bf16, model_name, seq_len,
-                                lm_overrides, zero1=zero1)
+                                lm_overrides, zero1=zero1,
+                                grad_sync=grad_sync)
     return build_image_trainer(devices, bf16, model_name, image_hw,
-                               num_classes, zero1=zero1)
+                               num_classes, zero1=zero1,
+                               grad_sync=grad_sync)
 
 
 def make_synth_batch(mesh, model_name: str, per_device_batch: int,
@@ -179,6 +189,32 @@ def synth_token_batch(mesh, per_device_batch: int, seq_len: int,
         "weight": np.ones(global_batch, np.float32),
     }, mesh)
     return batch, global_batch
+
+
+def trace_exposed_comm(build_fn, key=None, steps: int = 3):
+    """Best-effort exposed-comm fraction of a train step
+    (`trace_analysis.comm_overlap_split` over a short jax.profiler
+    capture). ``build_fn() -> (trainer, state, batch)`` must build a
+    SACRIFICIAL trainer/state: the jitted step donates its input state, so
+    a capture that dies mid-step consumes those buffers — they must never
+    be the ones a timed run still needs. Returns the percentage, or None
+    on any failure (the number is an observability nicety, never worth
+    failing a measurement for).
+    """
+    import tempfile
+
+    from .trace_analysis import capture_step_trace, comm_overlap_split
+
+    try:
+        trainer, state, batch = build_fn()
+        key = jax.random.PRNGKey(0) if key is None else key
+        state, _ = trainer._train_step(state, batch, key)  # warmup/compile
+        with tempfile.TemporaryDirectory(prefix="comm_trace_") as td:
+            capture_step_trace(trainer._train_step, state, batch, key, td,
+                               steps=steps)
+            return comm_overlap_split(td)["exposed_frac_pct"]
+    except Exception:
+        return None
 
 
 def _fetch(metrics) -> float:
@@ -262,7 +298,9 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                    image_hw: int = 32, num_classes: int = 10,
                    devices: Optional[Sequence[jax.Device]] = None,
                    true_fp32: bool = True, min_window_s: float = 0.5,
-                   zero1: bool = False) -> dict:
+                   zero1: bool = False,
+                   grad_sync: Optional[dict] = None,
+                   comm_trace: bool = False) -> dict:
     """Full self-verifying measurement of one training config.
 
     Returns a dict with samples/s, FLOPs from XLA cost analysis AND the
@@ -275,6 +313,13 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     fp32 matmul passes — without this, TPU "fp32" matmuls default to bf16 MXU
     passes and an AMP comparison measures nothing (the reference's AMP-vs-FP32
     experiment, /root/reference/README.md:31).
+
+    Every result carries the gradient-sync bucket census of the measured
+    executable (``grad_sync_census``: gradient-sized collective count +
+    wire dtypes) so bench history can track overlap/bucketing efficiency
+    across PRs; ``comm_trace=True`` additionally captures a short
+    jax.profiler trace and records the exposed-comm fraction
+    (``comm_overlap_split``) — best-effort, never a measurement failure.
     """
     import contextlib
 
@@ -288,7 +333,7 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     with ctx:
         trainer, state, mesh = build_trainer(
             devices, bf16, model_name, seq_len, image_hw, num_classes,
-            zero1=zero1)
+            zero1=zero1, grad_sync=grad_sync)
         batch, global_batch = make_synth_batch(
             mesh, model_name, per_device_batch, seq_len, image_hw,
             num_classes)
@@ -301,6 +346,23 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         analytic_fwd = flops_mod.jaxpr_matmul_flops(
             lambda s, b: trainer.task.loss_and_metrics(
                 s, s.params, b, key, train=True)[0], state, batch)
+
+        from .trace_analysis import grad_sync_census
+
+        sync_census = grad_sync_census(compiled.as_text())
+
+        exposed_comm_pct = None
+        if comm_trace and len(devices) > 1:
+            def _sacrificial():
+                trainer_t, state_t, mesh_t = build_trainer(
+                    devices, bf16, model_name, seq_len, image_hw,
+                    num_classes, zero1=zero1, grad_sync=grad_sync)
+                batch_t, _ = make_synth_batch(
+                    mesh_t, model_name, per_device_batch, seq_len, image_hw,
+                    num_classes)
+                return trainer_t, state_t, batch_t
+
+            exposed_comm_pct = trace_exposed_comm(_sacrificial, key=key)
 
         sps, samples_per_s = timed_steps(compiled, state, batch, global_batch,
                                          steps, repeats,
@@ -336,6 +398,7 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         "model": model_name,
         "bf16": bf16,
         **({"zero1": True} if zero1 else {}),
+        **({"grad_sync": grad_sync} if grad_sync else {}),
         "per_device_batch": per_device_batch,
         "global_batch": global_batch,
         "steps_per_sec": round(sps, 4),
@@ -347,7 +410,13 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                            if step_flops else None),
         "chip_peak_tflops_bf16": peak,
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        # overlap-efficiency instruments (ISSUE 2): the bucket census of
+        # the measured executable, and (comm_trace) the exposed-comm split
+        "grad_collectives": sync_census["n_collectives"],
+        "grad_wire_dtypes": sync_census["wire_dtypes"],
     }
+    if exposed_comm_pct is not None:
+        result["exposed_comm_pct"] = exposed_comm_pct
     if is_lm:
         result["seq_len"] = seq_len
         result["tokens_per_sec"] = round(samples_per_s * seq_len, 1)

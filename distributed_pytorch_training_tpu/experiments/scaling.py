@@ -13,6 +13,11 @@ outline (/root/reference/README.md:27-35):
   grads, 1/N optimizer update per replica, all-gather params — Xu et al.,
   PAPERS.md) on the same data-parallel mesh, with the static weight-update
   census proving which collectives each compiled step actually runs.
+* ``grad_sync`` — the explicit bucketed/compressed reducer
+  (parallel/grad_sync.py, the native DDP-reducer rebuild) vs the implicit
+  XLA path: throughput, the static bucket/wire-dtype census of each
+  compiled step, and the trace-derived exposed-comm fraction (overlap
+  efficiency) per mode.
 * ``gradsync`` — the gradient-synchronization share of step time (the
   README's literal "~X%" placeholder, README.md:35). Three instruments:
   (a) measured: per-device-constant-batch step time on 1 chip vs N chips —
@@ -62,7 +67,8 @@ from .harness import build_trainer, is_lm_model, make_synth_batch, timed_steps  
 _LM_TINY = dict(hidden_dim=64, depth=2, num_heads=2, mlp_dim=128)
 
 
-def _setup(devices, bf16: bool, args, per_device_batch=None, zero1=False):
+def _setup(devices, bf16: bool, args, per_device_batch=None, zero1=False,
+           grad_sync=None):
     """(trainer, state, mesh, batch, global_batch) for args' config — the
     trainer and its batch are built together so they can never mismatch."""
     lm_kw = None
@@ -72,7 +78,7 @@ def _setup(devices, bf16: bool, args, per_device_batch=None, zero1=False):
             lm_kw.pop("mlp_dim")  # gpt2 derives mlp from hidden_dim
     trainer, state, mesh = build_trainer(devices, bf16, args.model,
                                          args.seq_len, lm_overrides=lm_kw,
-                                         zero1=zero1)
+                                         zero1=zero1, grad_sync=grad_sync)
     batch, gb = make_synth_batch(mesh, args.model,
                                  per_device_batch or args.batch_size,
                                  args.seq_len)
@@ -274,6 +280,79 @@ def run_zero1(args) -> List[dict]:
     return rows
 
 
+def run_grad_sync(args) -> List[dict]:
+    """The explicit reducer (parallel/grad_sync.py) vs the implicit XLA
+    path on the same devices: bucketed fp32, bf16 and int8+EF wire, each
+    row carrying (a) throughput, (b) the static `grad_sync_census` of the
+    compiled step — gradient-sized collective count and wire dtypes, the
+    proof the mode is engaged — and (c) the trace-derived exposed-comm
+    fraction (`comm_overlap_split`), the overlap-efficiency number DDP
+    users read off nsys timelines. `--bucket-cap-mb` sets the cap
+    (default 25, DDP's default); `--grad-accum` > 1 exercises the
+    in-scan overlap (plus a no-overlap arm isolating its win).
+    """
+    from .harness import trace_exposed_comm
+    from .trace_analysis import grad_sync_census, preopt_hlo_text
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return [{"mode": "skipped",
+                 "global_samples_per_s": "needs >= 2 devices"}]
+    cap = args.bucket_cap_mb
+    accum = args.grad_accum
+    modes = [("implicit", None),
+             ("bucketed_fp32", dict(bucket_cap_mb=cap))]
+    if accum > 1:
+        modes.append(("bucketed_fp32_no_overlap",
+                      dict(bucket_cap_mb=cap, overlap_grad_sync=False)))
+    modes += [("bucketed_bf16", dict(bucket_cap_mb=cap, wire_dtype="bf16")),
+              ("bucketed_int8", dict(bucket_cap_mb=cap, wire_dtype="int8"))]
+
+    rows = []
+    for mode, gs in modes:
+        gs_full = dict(gs or {}, grad_accum=accum) if (gs or accum > 1) \
+            else gs
+        trainer, state, _, batch, gb = _setup(devices, args.bf16, args,
+                                              grad_sync=gs_full)
+        key = jax.random.PRNGKey(0)
+        lowered = trainer._train_step.lower(state, batch, key)
+        compiled = lowered.compile()
+        census = grad_sync_census(compiled.as_text())
+        # wire read: pre-optimization HLO (bf16 survives only there on CPU)
+        # — except for the implicit mode, whose collectives are inserted by
+        # GSPMD during compilation and don't exist pre-optimization
+        wire = census["wire_dtypes"]
+        try:
+            pre = grad_sync_census(preopt_hlo_text(lowered))["wire_dtypes"]
+            if pre:
+                wire = pre
+        except Exception:
+            pass
+
+        # time the SAME executable the census describes (AOT `compiled` —
+        # re-timing trainer._train_step would pay a second compile AND
+        # measure a different program than the one censused)
+        _, sps = timed_steps(compiled, state, batch, gb, args.steps,
+                             repeats=args.repeats,
+                             min_window_s=args.min_window_s)
+
+        # trace the same config with a sacrificial trainer/state (the
+        # timed run donated this one's buffers)
+        def _sacrificial(gs=gs_full):
+            tr, st, _, ba, _ = _setup(devices, args.bf16, args, grad_sync=gs)
+            return tr, st, ba
+
+        exposed = trace_exposed_comm(_sacrificial, key=key)
+        rows.append({
+            "mode": mode,
+            "global_samples_per_s": round(sps, 1),
+            "grad_collectives": census["n_collectives"],
+            "wire_dtypes": "+".join(sorted(wire)) or "-",
+            "exposed_comm_pct": exposed if exposed is not None else "-",
+        })
+    return rows
+
+
 def run_pipeline(args) -> List[dict]:
     """GPipe bubble measurement: pipelined GPT-2 throughput vs microbatch
     count, against the pure-DP layout of the same model on the same devices.
@@ -351,8 +430,8 @@ def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
-                   choices=["scaling", "batch", "amp", "gradsync", "zero1",
-                            "pipeline"])
+                   choices=["scaling", "batch", "amp", "gradsync",
+                            "grad_sync", "zero1", "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
@@ -372,13 +451,21 @@ def main(argv=None):
                         "bert_base; e.g. the BERT-512 grad-sync profiling "
                         "run, BASELINE config 4)")
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--bucket-cap-mb", default=25.0, type=float,
+                   help="bucket cap for the 'grad_sync' experiment "
+                        "(training/loop.py explicit reducer; DDP's "
+                        "default is 25)")
+    p.add_argument("--grad-accum", default=1, type=int,
+                   help="gradient accumulation for the 'grad_sync' "
+                        "experiment (> 1 exercises the in-scan overlap "
+                        "and adds a no-overlap arm)")
     p.add_argument("--csv", default=None,
                    help="append rows to this CSV (plots regenerate from it)")
     args = p.parse_args(argv)
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
-          "gradsync": run_gradsync, "zero1": run_zero1,
-          "pipeline": run_pipeline}[args.experiment]
+          "gradsync": run_gradsync, "grad_sync": run_grad_sync,
+          "zero1": run_zero1, "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "
           f"{len(jax.devices())} device(s) [{jax.default_backend()}]\n")

@@ -23,7 +23,7 @@ import gzip
 import json
 import re
 from pathlib import Path
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # Collective op names as they appear on XLA timelines (sync form, async
 # `-start` form, and CPU thunk form). `-done` events are completion markers
@@ -145,6 +145,11 @@ _HLO_COLLECTIVE_RE = re.compile(
 # a tuple). Captures the bracketed dims; "f32[]" is a scalar.
 _HLO_SHAPE_RE = re.compile(r"\w+\[([\d,]*)\]")
 
+# Same shape token with the DTYPE captured instead ("f32", "bf16", "s8") —
+# the wire-dtype read of `grad_sync_census`. Context/token dtypes (u32 ids
+# in async tuples) ride along; the census reports all of them.
+_HLO_TYPED_SHAPE_RE = re.compile(r"(\w+)\[[\d,]*\]")
+
 
 def hlo_result_elements(shape_str: str) -> int:
     """Total elements across every array in an HLO result shape string
@@ -230,6 +235,159 @@ def verify_zero1_collectives(replicated_text: str, zero1_text: str,
     if problems:
         raise AssertionError("; ".join(problems))
     return {"replicated": rep, "zero1": z1}
+
+
+def grad_sync_census(hlo_text: str, min_elements: int = 8192) -> dict:
+    """Census of the gradient-sync stage in HLO text: how many gradient-
+    sized collectives the step carries, and what dtype rides the wire.
+
+    The instrument for the bucketed reducer (parallel/grad_sync.py): with
+    ``bucket_cap_mb`` set, the compiled step must show
+    ``ceil(total_grad_bytes / cap)`` large collectives (one per bucket)
+    instead of one per leaf, and with a compressed ``wire_dtype`` their
+    operands must be bf16/s8, not f32. Accepts optimized HLO
+    (``compiled.as_text()``) or pre-optimization HLO (`preopt_hlo_text`):
+    CPU's float-normalization pass promotes bf16 collectives to f32 in the
+    OPTIMIZED text, so wire-dtype checks on the test backend read the
+    pre-optimization module (TPU keeps bf16 end-to-end).
+
+    Returns {"n_collectives", "by_op": {op: n}, "wire_dtypes": {dtype: n},
+    "rows": [...]} counting only collectives whose result carries at least
+    `min_elements` elements (scalar metric psums and int8 scale gathers
+    fall under the floor).
+    """
+    by_op: Dict[str, int] = {}
+    wire: Dict[str, int] = {}
+    rows = []
+    total = 0
+    for c in collective_census(hlo_text):
+        if hlo_result_elements(c["result_shape"]) < min_elements:
+            continue
+        total += c["count"]
+        by_op[c["op"]] = by_op.get(c["op"], 0) + c["count"]
+        dtypes = sorted(set(
+            m.group(1)
+            for m in _HLO_TYPED_SHAPE_RE.finditer(c["result_shape"])))
+        for d in dtypes:
+            wire[d] = wire.get(d, 0) + c["count"]
+        rows.append({**c, "dtypes": dtypes})
+    return {"n_collectives": total, "by_op": by_op, "wire_dtypes": wire,
+            "rows": rows}
+
+
+def preopt_hlo_text(lowered) -> str:
+    """Pre-optimization HLO text of a ``jax.jit(...).lower(...)`` result —
+    the wire-dtype read for `grad_sync_census` (see its docstring: the CPU
+    backend's float-normalization rewrites bf16 collectives to f32 before
+    the optimized text is printed)."""
+    return lowered.compiler_ir(dialect="hlo").as_hlo_text()
+
+
+def verify_grad_sync_collectives(
+    optimized_text: str,
+    *,
+    total_grad_bytes: int,
+    bucket_cap_mb: float,
+    wire_dtype: str = "fp32",
+    wire_text: Optional[str] = None,
+    min_elements: int = 8192,
+    slack: int = 2,
+) -> dict:
+    """The ISSUE-2 acceptance check for the bucketed reducer: the compiled
+    step performs at most ``ceil(total_grad_bytes / bucket_cap) + slack``
+    gradient-sized collectives, and compressed modes put bf16/int8 on the
+    wire. ``wire_text`` defaults to ``optimized_text``; pass the
+    pre-optimization HLO on backends that promote small floats (CPU).
+    Raises AssertionError naming the violation; returns the censuses.
+    """
+    census = grad_sync_census(optimized_text, min_elements)
+    # The SAME arithmetic as grad_sync.build_bucket_plan (which floors the
+    # cap to whole fp32 elements): re-deriving it as ceil(bytes/cap_bytes)
+    # would under-count buckets whenever the cap is not element-aligned and
+    # flag a correctly engaged reducer.
+    total_elems = int(total_grad_bytes) // 4
+    cap_elems = int(bucket_cap_mb * (1024 ** 2) // 4)
+    if bucket_cap_mb <= 0 or cap_elems >= total_elems:
+        n_buckets = 1  # no/huge cap = one fused bucket
+    else:
+        n_buckets = -(-total_elems // max(cap_elems, 1))
+    bound = n_buckets + slack
+    if census["n_collectives"] > bound:
+        raise AssertionError(
+            f"bucketed step carries {census['n_collectives']} gradient-"
+            f"sized collectives, more than ceil({total_grad_bytes}B / "
+            f"{bucket_cap_mb}MB) + {slack} = {bound}: {census['by_op']} — "
+            "bucketing is not engaged (or the census floor "
+            f"min_elements={min_elements} is below scalar traffic)")
+    if census["n_collectives"] == 0:
+        raise AssertionError(
+            "no gradient-sized collectives found — the census floor "
+            f"(min_elements={min_elements}) is above the model's gradient "
+            "transfers; lower it")
+    wire_census = (grad_sync_census(wire_text, min_elements)
+                   if wire_text is not None else census)
+    expect = {"fp32": "f32", "bf16": "bf16", "int8": "s8"}[wire_dtype]
+    if not wire_census["wire_dtypes"].get(expect):
+        raise AssertionError(
+            f"wire_dtype={wire_dtype!r} promises {expect} collective "
+            f"operands on the wire, but the HLO shows "
+            f"{wire_census['wire_dtypes']}")
+    return {"census": census, "wire": wire_census["wire_dtypes"],
+            "bound": bound}
+
+
+def comm_overlap_split(log_dir: str) -> dict:
+    """Exposed-vs-hidden communication time from a jax.profiler trace —
+    the overlap instrument of the bucketed reducer (DDP's hooks hide comm
+    behind backward compute; here the scan-body collectives have no data
+    dependency on the next microbatch, and this measures how much of their
+    wall time XLA actually hid).
+
+    A collective event's duration is HIDDEN where it overlaps (same pid,
+    any lane) with non-collective op execution, EXPOSED elsewhere. On TPU
+    timelines async ``-start`` events span the transfer, so the split is
+    honest; on the CPU test backend thunks serialize on the threadpool, so
+    exposed ~= 100% — the number is only meaningful with device lanes.
+
+    Returns {collective_us, hidden_us, exposed_us, exposed_frac_pct}.
+    """
+    events, pids, tids = load_trace(log_dir)
+    ops = xla_op_events(events, pids, tids)
+    comp_by_pid: Dict[int, List[Tuple[float, float]]] = {}
+    coll = []
+    for e in ops:
+        iv = (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
+        if _COLLECTIVE_RE.match(_norm(e["name"])):
+            coll.append((e.get("pid"), iv))
+        else:
+            comp_by_pid.setdefault(e.get("pid"), []).append(iv)
+    merged: Dict[int, List[Tuple[float, float]]] = {}
+    for pid, ivs in comp_by_pid.items():
+        ivs.sort()
+        out: List[Tuple[float, float]] = []
+        for a, b in ivs:
+            if out and a <= out[-1][1]:
+                out[-1] = (out[-1][0], max(out[-1][1], b))
+            else:
+                out.append((a, b))
+        merged[pid] = out
+    total = hidden = 0.0
+    for pid, (a, b) in coll:
+        total += b - a
+        for ca, cb in merged.get(pid, ()):
+            if cb <= a:
+                continue
+            if ca >= b:
+                break
+            hidden += min(b, cb) - max(a, ca)
+    exposed = max(0.0, total - hidden)
+    return {
+        "collective_us": round(total, 1),
+        "hidden_us": round(hidden, 1),
+        "exposed_us": round(exposed, 1),
+        "exposed_frac_pct": round(100.0 * exposed / total, 2) if total
+        else 0.0,
+    }
 
 
 def capture_step_trace(step_fn, state, batch, key, log_dir: str,

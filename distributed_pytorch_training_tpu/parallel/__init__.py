@@ -32,6 +32,15 @@ from .collectives import (  # noqa: F401
     reduce_scalar,
     shard_map,
 )
+from .grad_sync import (  # noqa: F401
+    WIRE_DTYPES,
+    BucketPlan,
+    build_bucket_plan,
+    compressed_psum_scatter,
+    flatten_tree,
+    reduce_flat,
+    unflatten_tree,
+)
 from .sharding import (  # noqa: F401
     PartitionRules,
     batch_sharding,

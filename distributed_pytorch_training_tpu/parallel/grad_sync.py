@@ -1,0 +1,322 @@
+"""Gradient synchronization as an explicit, configurable, profiled stage —
+the TPU-native rebuild of DDP's C++ reducer (bucketed, backward-overlapped,
+optionally compressed all-reduce; /root/reference/train_ddp.py:305-310 wraps
+it, README.md:35 promises to profile it).
+
+The repo's default data-parallel path leaves gradient sync to XLA: the batch
+is sharded over the mesh, the loss mean contracts over the global batch, and
+the compiler inserts one all-reduce per gradient leaf wherever its scheduler
+likes. That is correct but opaque — O(leaves) small collectives, no knob for
+wire precision, nothing to profile against. This module makes the reducer
+explicit, with the three levers DDP exposes (and two it doesn't):
+
+* **Bucketing** (`BucketPlan`): gradients are flattened into ONE fp32 vector
+  (leaf order = `jax.tree_util.tree_leaves` order, the documented
+  reassociation order) and cut into contiguous size-capped buckets — the
+  `bucket_cap_mb` analog. The compiled step then carries
+  ``ceil(total_grad_bytes / cap)`` large collectives instead of one per
+  leaf. Unlike DDP, bucket boundaries may split a leaf: the plan chunks the
+  concatenated vector, so the bucket count meets the ceil bound exactly
+  (DDP's greedy per-tensor packing can only promise 2x it).
+* **Wire compression** (`reduce_flat`, `compressed_psum_scatter`): the
+  collective operand dtype is a choice, not a given. ``bf16`` halves wire
+  bytes (sum accumulates in bf16 on TPU — bounded error, no state);
+  ``int8`` uses per-bucket max-abs scales plus **error feedback**
+  (Karimireddy et al.; the DynamiQ lever, PAPERS.md): the quantization
+  residual is carried to the next reduction so the bias telescopes instead
+  of accumulating. Master accumulation is always fp32 — compression
+  touches only the wire. Honest accounting for the int8 BUCKETED form
+  (gather-based, see below): per-replica ring traffic is ~(n-1)·S bytes
+  vs ~8·S for an uncompressed fp32 all-reduce, so the byte saving is real
+  only for small DP degrees (break-even near n=9); the zero1 int8 scatter
+  (s8 all-to-all, ~1 B/element regardless of n) does not have this
+  scaling. The n-independent fix for the bucketed path — multi-hop
+  reduce-scatter with REQUANTIZATION of the partial sums before the
+  gather hop (DynamiQ's scheme) — costs a second collective per bucket
+  and is the ROADMAP follow-up.
+* **Overlap** is the caller's third lever: `training/loop.py` reduces
+  microbatch *i*'s buckets INSIDE the grad-accum scan body, so the
+  collective for step *i* has no data dependency on step *i+1*'s compute
+  and XLA's latency-hiding scheduler can run them concurrently — exposed
+  comm time becomes hidden time (measured by
+  `experiments.trace_analysis.comm_overlap_split`).
+
+Everything here is shard_map-body code: collectives take bound mesh axis
+names, never a Mesh. The int8 wire uses all-gather / all-to-all (each
+replica's quantized contribution travels with its own scale and is summed
+AFTER dequantization) because a SUM all-reduce of int8 operands would
+overflow at 2 replicas — the gather form is what keeps s8 on the wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+WIRE_DTYPES = ("fp32", "bf16", "int8")
+
+# Quantization grid half-width: int8 values in [-127, 127] (symmetric; -128
+# unused so the grid is zero-centered and dequantization is a pure scale).
+_QMAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# Bucket plan
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """Static layout of the flattened gradient vector.
+
+    ``bounds`` are cumulative element offsets cutting the concatenated fp32
+    gradient vector into buckets: bucket k is ``flat[bounds[k]:bounds[k+1]]``.
+    Built from parameter SHAPES only, so it is identical at trace time and
+    across processes (no data-dependent layout).
+    """
+
+    total_size: int           # elements in the concatenated gradient vector
+    bounds: Tuple[int, ...]   # len == n_buckets + 1; bounds[0] == 0
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.bounds) - 1
+
+    @property
+    def total_bytes(self) -> int:
+        """fp32 master bytes of one full gradient (the bucket-cap currency)."""
+        return self.total_size * 4
+
+    def bucket_sizes(self) -> Tuple[int, ...]:
+        return tuple(b - a for a, b in zip(self.bounds, self.bounds[1:]))
+
+
+def build_bucket_plan(params: Any, bucket_cap_mb: float) -> BucketPlan:
+    """Cut the flattened gradient of ``params`` into size-capped buckets.
+
+    ``bucket_cap_mb`` caps each bucket at that many MB of fp32 elements
+    (DDP's ``bucket_cap_mb``, default 25 there). ``<= 0`` means one bucket —
+    a single fused collective, the fully-flat extreme. The bucket count is
+    exactly ``ceil(total_fp32_bytes / cap_bytes)``: boundaries cut the
+    concatenated vector, not the leaf list, so no greedy-packing slack.
+    """
+    total = int(sum(np.prod(np.shape(leaf)) or 1
+                    for leaf in jax.tree_util.tree_leaves(params)))
+    if total == 0:
+        return BucketPlan(total_size=0, bounds=(0,) * 2)
+    cap_elems = int(bucket_cap_mb * (1024 ** 2) // 4)
+    if bucket_cap_mb <= 0 or cap_elems >= total:
+        return BucketPlan(total_size=total, bounds=(0, total))
+    cap_elems = max(1, cap_elems)
+    bounds = tuple(range(0, total, cap_elems)) + (total,)
+    plan = BucketPlan(total_size=total, bounds=bounds)
+    assert plan.n_buckets == math.ceil(total / cap_elems)
+    return plan
+
+
+def flatten_tree(tree: Any) -> jnp.ndarray:
+    """Concatenate every leaf (ravelled, cast fp32) in tree-leaves order —
+    the master flat gradient the buckets slice. This fixed order IS the
+    documented reassociation order of the bucketed reducer."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate(
+        [jnp.ravel(leaf).astype(jnp.float32) for leaf in leaves])
+
+
+def unflatten_tree(flat: jnp.ndarray, like: Any) -> Any:
+    """Rebuild a pytree shaped like ``like`` from the flat vector, casting
+    each leaf back to its template's dtype (fp32 master -> param dtype)."""
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out = []
+    offset = 0
+    for leaf in leaves:
+        size = int(np.prod(np.shape(leaf)) or 1)
+        out.append(
+            lax.slice_in_dim(flat, offset, offset + size)
+            .reshape(np.shape(leaf)).astype(jnp.result_type(leaf)))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Compressed collectives (shard_map-body code: axis names must be bound)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_int8(v: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(int8 codes, fp32 scale): symmetric per-bucket max-abs scaling."""
+    scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30) / _QMAX
+    q = jnp.clip(jnp.round(v / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def _int8_gather_sum(q: jnp.ndarray, scale: jnp.ndarray,
+                     axis_names: Sequence[str], n_shards: int) -> jnp.ndarray:
+    """SUM-of-dequantized across replicas via an s8 all-gather.
+
+    Each replica contributes (codes, scale); codes ride the wire as s8
+    (the compression), scales as one fp32 scalar per replica (noise). The
+    sum happens AFTER dequantization, locally and in the same axis order on
+    every replica — so the result is exactly replicated, and no int8
+    overflow is possible. Wire scaling caveat: an all-gather moves every
+    replica's codes to every replica (~(n-1)·S bytes each), so the saving
+    over a fp32 all-reduce (~8·S) erodes as n grows — see the module
+    docstring.
+    """
+    gathered = lax.all_gather(q, axis_names, axis=0, tiled=True)
+    scales = lax.all_gather(scale[None], axis_names, axis=0, tiled=True)
+    per_replica = gathered.reshape(n_shards, -1).astype(jnp.float32)
+    return jnp.sum(per_replica * scales[:, None], axis=0)
+
+
+def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
+                     n_shards: int, wire_dtype: str,
+                     residual: Optional[jnp.ndarray]
+                     ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """One bucket's SUM all-reduce at the chosen wire dtype.
+
+    Returns ``(fp32 global sum, new residual)``; the residual is None unless
+    ``wire_dtype == 'int8'`` (error feedback: what this replica's
+    quantization dropped, to be re-injected at its next reduction).
+    """
+    names = tuple(axis_names)
+    if wire_dtype == "fp32":
+        return lax.psum(v, names), residual
+    if wire_dtype == "bf16":
+        # wire + accumulation in bf16 (that is the point: half the bytes);
+        # the caller keeps the fp32 master copy
+        return lax.psum(v.astype(jnp.bfloat16), names).astype(jnp.float32), \
+            residual
+    if wire_dtype != "int8":
+        raise ValueError(f"unknown wire dtype {wire_dtype!r} "
+                         f"(choose from {WIRE_DTYPES})")
+    if residual is None:
+        raise ValueError("int8 wire needs an error-feedback residual "
+                         "(Trainer.init_state builds it)")
+    carried = v + residual
+    q, scale = _quantize_int8(carried)
+    new_residual = carried - q.astype(jnp.float32) * scale
+    return _int8_gather_sum(q, scale, names, n_shards), new_residual
+
+
+def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
+                axis_names: Sequence[str], n_shards: int, wire_dtype: str,
+                residual: Optional[jnp.ndarray] = None
+                ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Reduce the flat local gradient vector bucket-by-bucket.
+
+    ``flat``: this replica's (total_size,) fp32 contribution (weight-scaled
+    gradient sums). Returns the globally-summed fp32 vector and the updated
+    error-feedback residual (same shape, int8 wire only). One collective per
+    bucket — the O(buckets) contract `grad_sync_census` verifies in HLO.
+    """
+    outs: List[jnp.ndarray] = []
+    res_outs: List[jnp.ndarray] = []
+    for a, b in zip(plan.bounds, plan.bounds[1:]):
+        v = lax.slice_in_dim(flat, a, b)
+        r = (lax.slice_in_dim(residual, a, b)
+             if residual is not None else None)
+        summed, new_r = _compressed_psum(v, axis_names, n_shards,
+                                         wire_dtype, r)
+        outs.append(summed)
+        if new_r is not None:
+            res_outs.append(new_r)
+    synced = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    new_residual = (jnp.concatenate(res_outs) if len(res_outs) > 1
+                    else res_outs[0]) if res_outs else None
+    return synced, new_residual
+
+
+def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
+                            n_shards: int, wire_dtype: str,
+                            residual: Optional[jnp.ndarray] = None
+                            ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Reduce-scatter one flat-padded leaf at the chosen wire dtype — the
+    compressed half-all-reduce of the ZeRO-1 update (training/loop.py).
+
+    ``v``: (padded,) local fp32, padded size divisible by ``n_shards``.
+    Returns this replica's (padded/n,) fp32 chunk of the cross-replica sum
+    plus the updated error-feedback residual (int8 only, full padded size —
+    EF must remember what was dropped from EVERY chunk, not just the kept
+    one). int8 rides an s8 all-to-all: replica j receives every peer's
+    chunk j (2 wire bytes per 8 fp32 bytes, scatter-half included), then
+    dequantizes with the peers' gathered scales and sums in fp32.
+    """
+    names = tuple(axis_names)
+    if wire_dtype == "fp32":
+        return lax.psum_scatter(v, names, scatter_dimension=0, tiled=True), \
+            residual
+    if wire_dtype == "bf16":
+        return lax.psum_scatter(v.astype(jnp.bfloat16), names,
+                                scatter_dimension=0,
+                                tiled=True).astype(jnp.float32), residual
+    if wire_dtype != "int8":
+        raise ValueError(f"unknown wire dtype {wire_dtype!r} "
+                         f"(choose from {WIRE_DTYPES})")
+    if residual is None:
+        raise ValueError("int8 wire needs an error-feedback residual "
+                         "(Trainer.init_state builds it)")
+    carried = v + residual
+    q, scale = _quantize_int8(carried)
+    new_residual = carried - q.astype(jnp.float32) * scale
+    received = lax.all_to_all(q, names, split_axis=0, concat_axis=0,
+                              tiled=True)  # (padded,) s8: peers' chunk j
+    scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
+    per_replica = received.reshape(n_shards, -1).astype(jnp.float32)
+    return jnp.sum(per_replica * scales[:, None], axis=0), new_residual
+
+
+# ---------------------------------------------------------------------------
+# Error-feedback state constructors (host-side; Trainer.init_state calls)
+# ---------------------------------------------------------------------------
+
+
+def _born_sharded_zeros(structs: Any, mesh):
+    """Zeros pytree (of jax.ShapeDtypeStruct leaves) created ALREADY
+    sharded over the batch axes (the optim.zero1_opt_state idiom): jit
+    with out_shardings makes XLA allocate each replica's rows in place —
+    no full-array transient on device 0 (for gpt2-scale params,
+    n_shards x param bytes would be a multi-GB spike at init_state)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh import BATCH_AXES
+
+    shardings = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(BATCH_AXES)), structs)
+    make = jax.jit(
+        lambda: jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), structs),
+        out_shardings=shardings)
+    return make()
+
+
+def ef_state_bucketed(params: Any, mesh, n_shards: int):
+    """Per-replica error-feedback residual for the bucketed reducer: one
+    (n_shards, total_size) fp32 array, row r = replica r's residual,
+    sharded over the batch axes so each replica materializes only its row.
+    """
+    total = int(sum(np.prod(np.shape(leaf)) or 1
+                    for leaf in jax.tree_util.tree_leaves(params)))
+    struct = jax.ShapeDtypeStruct((n_shards, total), jnp.float32)
+    return {"ef": _born_sharded_zeros(struct, mesh)}
+
+
+def ef_state_zero1(params: Any, mesh, n_shards: int):
+    """Per-replica residuals for the zero1 int8 scatter: one
+    (n_shards, flat_padded_size) fp32 array PER LEAF (the scatter is
+    per-leaf there), sharded over the batch axes."""
+    from .sharding import flat_padded_size
+
+    structs = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(
+            (n_shards,
+             flat_padded_size(int(np.prod(np.shape(p)) or 1), n_shards)),
+            jnp.float32),
+        params)
+    return {"ef": _born_sharded_zeros(structs, mesh)}

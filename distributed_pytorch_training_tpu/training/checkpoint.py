@@ -4,9 +4,9 @@ multi-host training on preemptible TPU pods.
 
 Orbax-backed: sharded async-capable writes, multi-host-safe (every process
 participates; no rank-0 funnel). Only the array pytrees are persisted
-(step/params/batch_stats/opt_state); `apply_fn`/`tx` are code, reconstructed
-by the caller — restoring requires a template TrainState with matching
-structure, which `train.py` always has before resume.
+(step/params/batch_stats/opt_state/grad_sync); `apply_fn`/`tx` are code,
+reconstructed by the caller — restoring requires a template TrainState with
+matching structure, which `train.py` always has before resume.
 """
 
 from __future__ import annotations
@@ -21,7 +21,7 @@ from .train_state import TrainState
 
 
 def _arrays(state: TrainState, epoch: int = 0, step_in_epoch: int = 0) -> dict:
-    return {
+    arrays = {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
@@ -34,6 +34,16 @@ def _arrays(state: TrainState, epoch: int = 0, step_in_epoch: int = 0) -> dict:
         "epoch": np.asarray(epoch, np.int32),
         "step_in_epoch": np.asarray(step_in_epoch, np.int32),
     }
+    # int8-wire error-feedback residuals (parallel/grad_sync.py): the
+    # carried quantization remainder IS trajectory state — dropping it at
+    # resume re-introduces the bias EF exists to cancel. Included only
+    # when non-empty so every other mode's checkpoints keep the legacy
+    # structure (resumable across this feature's introduction, both ways).
+    import jax
+
+    if jax.tree_util.tree_leaves(state.grad_sync):
+        arrays["grad_sync"] = state.grad_sync
+    return arrays
 
 
 class CheckpointManager:
@@ -70,13 +80,27 @@ class CheckpointManager:
         label = self._mgr.latest_step()
         if label is None:
             return None
+        want = _arrays(template)
+        if "grad_sync" in want:
+            # An int8-wire template resuming a checkpoint written WITHOUT
+            # EF residuals (pre-feature, or the flag was just turned on):
+            # orbax rejects a template key the checkpoint lacks outright,
+            # so drop it and let the .get below keep the template's
+            # zero-initialized residuals — error feedback restarts its
+            # telescope from zero, which is exactly a fresh-start step.
+            meta = self.latest_metadata()
+            if meta is not None and "grad_sync" not in meta:
+                want.pop("grad_sync")
         restored = self._mgr.restore(
-            label, args=ocp.args.StandardRestore(_arrays(template)))
+            label, args=ocp.args.StandardRestore(want))
         state = template.replace(
             step=restored["step"],
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
+            # .get: checkpoints written before grad_sync existed restore
+            # into non-EF templates (grad_sync={}) unchanged
+            grad_sync=restored.get("grad_sync", template.grad_sync),
         )
         return state, int(restored["epoch"]), int(restored["step_in_epoch"])
 

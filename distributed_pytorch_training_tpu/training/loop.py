@@ -30,6 +30,10 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
+from ..parallel.grad_sync import (
+    WIRE_DTYPES, build_bucket_plan, compressed_psum_scatter, ef_state_bucketed,
+    ef_state_zero1, flatten_tree, reduce_flat, unflatten_tree,
+)
 from ..parallel.mesh import BATCH_AXES, batch_shard_count
 from ..parallel.sharding import (
     PartitionRules, batch_spec, dp_flat_specs, flatten_pad, shard_pytree,
@@ -62,6 +66,30 @@ class TrainConfig:
     # the DP degree. Off = the replicated (DDP-equivalent) update. No-op on
     # a single batch shard (the collectives' passthrough convention).
     zero1: bool = False
+    # -- explicit gradient synchronization (parallel/grad_sync.py) --------
+    # bucket_cap_mb > 0 engages the bucketed reducer (the DDP bucket_cap_mb
+    # analog): gradients flatten into ceil(total_bytes / cap) contiguous
+    # fp32 buckets, each synced by ONE collective — O(buckets) large
+    # transfers instead of XLA's O(leaves) small ones. 0 = the implicit
+    # path (gradient sync left to XLA layout propagation). Incompatible
+    # with zero1 (whose per-leaf flat-shard layout IS its optimizer-state
+    # checkpoint format).
+    bucket_cap_mb: float = 0.0
+    # Gradient wire dtype: "fp32" (exact), "bf16" (half the wire bytes,
+    # bf16 accumulation on the wire — bounded error), or "int8" (per-
+    # bucket max-abs scales + error feedback carrying the quantization
+    # residual to the next step; the bucketed form is gather-based, a byte
+    # win at small DP degrees — see grad_sync.py's accounting). Master
+    # accumulation and the optimizer always run fp32. Any non-fp32 value
+    # engages the explicit reducer; composes with zero1 (the reduce-
+    # scatter half compresses via s8 all-to-all, n-independently).
+    wire_dtype: str = "fp32"
+    # In grad-accum mode, reduce microbatch i's buckets INSIDE the scan
+    # body (no data dependency on microbatch i+1's compute, so XLA can
+    # overlap comm with compute — DDP's backward-hook overlap). False =
+    # accumulate locally and reduce once after the scan (exposes the comm;
+    # exists to measure the overlap win).
+    overlap_grad_sync: bool = True
 
 
 class Trainer:
@@ -83,31 +111,59 @@ class Trainer:
         self._flops_per_sample: Optional[float] = None
         self._peak_flops_total: Optional[float] = None
 
+        if config.wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"wire_dtype {config.wire_dtype!r} is not one of "
+                f"{WIRE_DTYPES}")
+        if config.bucket_cap_mb < 0:
+            raise ValueError(
+                f"bucket_cap_mb must be >= 0, got {config.bucket_cap_mb}")
+        if config.zero1 and config.bucket_cap_mb > 0:
+            raise ValueError(
+                "bucket_cap_mb is the bucketed reducer of the replicated "
+                "update path; zero1's per-leaf flat-shard layout IS its "
+                "optimizer-state (and checkpoint) format — use zero1 with "
+                "wire_dtype compression, or the bucketed reducer without "
+                "zero1, not both")
+        explicit_sync = (config.bucket_cap_mb > 0
+                         or config.wire_dtype != "fp32")
         self._zero1_n = batch_shard_count(mesh)
         self._zero1 = bool(config.zero1) and self._zero1_n > 1
-        if config.zero1:
+        self._grad_sync = (explicit_sync and not config.zero1
+                           and self._zero1_n > 1)
+        if config.zero1 or explicit_sync:
+            # Both modes run the step in a shard_map over the batch axes
+            # with replicated parameters — same mesh constraints.
+            mode = "zero1" if config.zero1 else "grad_sync (bucket_cap_mb/" \
+                "wire_dtype)"
             bad = sorted(a for a, s in mesh.shape.items()
                          if s > 1 and a not in BATCH_AXES)
             if bad:
                 raise ValueError(
-                    f"zero1 shards the weight update over the data-parallel "
+                    f"{mode} runs gradient sync over the data-parallel "
                     f"axes {BATCH_AXES}; mesh axes {bad} > 1 need the "
-                    "replicated update path (TP/SP/PP/EP collectives are "
-                    "per-layer, not per-update)")
+                    "implicit path (TP/SP/PP/EP collectives are per-layer, "
+                    "not per-update)")
             if rules is not None:
                 conflict = sorted(
                     rules.axes_used()
                     & {a for a in BATCH_AXES if mesh.shape[a] > 1})
                 if conflict:
                     raise ValueError(
-                        f"zero1 assumes replicated parameters, but the "
+                        f"{mode} assumes replicated parameters, but the "
                         f"partition rules shard params over {conflict} — "
-                        "use either zero1 (optimizer-state sharding) or "
-                        "fsdp parameter sharding on this mesh, not both")
-            if not self._zero1:
+                        "use either the explicit update/sync modes "
+                        "(zero1/grad_sync) or fsdp parameter sharding on "
+                        "this mesh, not both")
+            if config.zero1 and not self._zero1:
                 log_main("NOTE: zero1 requested on a single batch shard — "
                          "running the replicated update (identity "
                          "passthrough, like single-process DDP)")
+            if not config.zero1 and explicit_sync and not self._grad_sync:
+                log_main("NOTE: explicit gradient sync requested on a "
+                         "single batch shard — nothing to synchronize; "
+                         "running the implicit path (identity passthrough, "
+                         "like single-process DDP)")
 
         donate = (0,) if config.donate_state else ()
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=donate)
@@ -131,6 +187,8 @@ class Trainer:
 
         if self._zero1:
             return self._zero1_step(state, batch, rng)
+        if self._grad_sync:
+            return self._grad_sync_step(state, batch, rng)
 
         if accum <= 1:
             def loss_fn(params):
@@ -233,6 +291,174 @@ class Trainer:
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
         return new_state, metrics
 
+    # -- explicit bucketed / compressed gradient sync ------------------------
+
+    def _grad_sync_step(self, state: TrainState, batch, rng):
+        """The native DDP reducer (parallel/grad_sync.py): the step runs in
+        a shard_map over the batch axes, each replica computes its LOCAL
+        weight-scaled gradient sum, flattens it into the bucket plan's flat
+        vector, and syncs bucket-by-bucket at the configured wire dtype;
+        the (replicated) optimizer update consumes the fp32 global mean.
+        In grad-accum mode with overlap on, each microbatch's buckets are
+        reduced INSIDE the scan body — microbatch i's collectives have no
+        data dependency on microbatch i+1's compute, so XLA's latency-
+        hiding scheduler can run them concurrently (DDP's backward-hook
+        overlap, done by dependence structure instead of hooks).
+
+        Equivalence scope vs the implicit path, same batch:
+        * The REASSOCIATION ORDER changes: the implicit path lets XLA
+          contract the loss mean over the global batch; here each replica
+          sums its local batch first and the psum combines replicas (and,
+          under accumulation with overlap, per-microbatch psums sum
+          instead of one psum of sums). Within a bucket, leaves keep
+          `jax.tree_util.tree_leaves` order. Same real-number gradient,
+          fp-rounding-level differences — the parity contract
+          tests/test_grad_sync.py pins with tolerances and documents.
+          Bucket BOUNDARIES never change math: per-element reductions are
+          independent, so different bucket_cap_mb values produce
+          bit-identical trajectories (also pinned).
+        * bf16 wire: the cross-replica sum accumulates in bf16 — a bounded
+          per-step perturbation, convergence pinned on the tiny-LM task.
+        * int8 wire: per-bucket max-abs quantization with error feedback —
+          biased per step, telescoping across steps; convergence pinned.
+        * stochastic tasks / BatchNorm: the zero1 caveats verbatim (each
+          shard folds its index into the step RNG; BN normalizes by
+          per-shard statistics, torch DDP's per-GPU BN semantics).
+        """
+        mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
+        axes = BATCH_AXES
+        task, cfg = self.task, self.config
+        wire, overlap = cfg.wire_dtype, cfg.overlap_grad_sync
+        has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        outer = state
+        plan = build_bucket_plan(state.params, cfg.bucket_cap_mb)
+        use_ef = wire == "int8"
+        if use_ef and not state.grad_sync:
+            raise ValueError(
+                "wire_dtype='int8' needs error-feedback buffers — build "
+                "the state via Trainer.init_state (TrainState.grad_sync is "
+                "empty)")
+
+        rep = P()
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: batch_spec(jnp.ndim(x)), batch)
+        ef_spec = P(axes)
+
+        def body(params, opt_state, stats, lbatch, key, step, *maybe_ef):
+            inner = outer.replace(step=step, params=params,
+                                  batch_stats=stats, opt_state=opt_state)
+            idx = lax.axis_index(axes)
+            ef_l = maybe_ef[0][0] if use_ef else None  # (S,) local residual
+
+            def micro_grads(mb, k):
+                def loss_fn(p):
+                    return task.loss_and_metrics(inner, p, mb, k, train=True)
+
+                return jax.grad(loss_fn, has_aux=True)(params)
+
+            if accum <= 1:
+                key = jax.random.fold_in(key, idx)
+                g, (m, stats_l) = micro_grads(lbatch, key)
+                w = m["weight"]
+                flat = flatten_tree(jax.tree_util.tree_map(
+                    lambda a: w * a.astype(jnp.float32), g))
+                flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l)
+                s_sum = (jax.tree_util.tree_map(
+                    lambda s: w * s.astype(jnp.float32), stats_l)
+                    if has_stats else stats)
+                m_local = m
+            else:
+                # the replicated path's interleaved LOCAL split (zero1's
+                # argument verbatim: local rows i::accum are the shard's
+                # part of global microbatch i)
+                def split(x):
+                    if x.ndim == 0:
+                        return jnp.broadcast_to(x, (accum,))
+                    if x.shape[0] % accum:
+                        raise ValueError(
+                            f"per-shard batch {x.shape[0]} not divisible "
+                            f"by grad_accum={accum}")
+                    return x.reshape(x.shape[0] // accum, accum,
+                                     *x.shape[1:]).swapaxes(0, 1)
+
+                micro_batches = jax.tree_util.tree_map(split, lbatch)
+                keys = jax.random.split(key, accum)
+
+                def mb_body(carry, xs):
+                    acc, s_sum, m_sum, ef_c = carry
+                    mb, k = xs
+                    g, (m, stats_mb) = micro_grads(
+                        mb, jax.random.fold_in(k, idx))
+                    w = m["weight"]
+                    flat = flatten_tree(jax.tree_util.tree_map(
+                        lambda a: w * a.astype(jnp.float32), g))
+                    if overlap:
+                        # sync THIS microbatch's buckets now — the carry
+                        # holds already-global sums, and the collective
+                        # overlaps the next microbatch's compute
+                        flat, ef_c = reduce_flat(flat, plan, axes, n,
+                                                 wire, ef_c)
+                    acc = acc + flat
+                    if has_stats:
+                        s_sum = jax.tree_util.tree_map(
+                            lambda a, b: a + w * b.astype(a.dtype),
+                            s_sum, stats_mb)
+                    m_sum = add_metrics(m_sum, m)
+                    return (acc, s_sum, m_sum, ef_c), None
+
+                acc0 = jnp.zeros((plan.total_size,), jnp.float32)
+                s0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), stats)
+                (flat, s_sum, m_local, ef_l), _ = lax.scan(
+                    mb_body, (acc0, s0, zero_metrics(), ef_l),
+                    (micro_batches, keys))
+                if not overlap:
+                    flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l)
+
+            # metric fan-in (the zero1 comment verbatim: 3 scalar psums)
+            metrics = jax.tree_util.tree_map(
+                lambda v: psum(v, axes), m_local)
+            total_w = jnp.maximum(metrics["weight"], 1.0)
+            grads = unflatten_tree(flat / total_w, params)
+
+            # replicated update from the synced global-mean gradient — the
+            # optimizer must NOT carry shard_axes here (grads are already
+            # global; a psum'd clip norm would count every replica n times)
+            updates, new_opt = outer.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+
+            if has_stats:
+                new_stats = jax.tree_util.tree_map(
+                    lambda s, old: jnp.where(
+                        metrics["weight"] > 0,
+                        psum(s, axes) / total_w,
+                        old.astype(jnp.float32)).astype(old.dtype),
+                    s_sum, stats)
+            else:
+                new_stats = stats
+            out = (new_params, new_opt, new_stats, metrics)
+            if use_ef:
+                out += (ef_l[None],)
+            return out
+
+        in_specs = (rep, rep, rep, batch_specs, rep, rep)
+        out_specs = (rep, rep, rep, rep)
+        args = [state.params, state.opt_state, state.batch_stats, batch,
+                rng, state.step]
+        if use_ef:
+            in_specs += (ef_spec,)
+            out_specs += (ef_spec,)
+            args.append(state.grad_sync["ef"])
+        stepped = shard_map(body, mesh, in_specs=in_specs,
+                            out_specs=out_specs)
+        res = stepped(*args)
+        new_params, new_opt, new_stats, metrics = res[:4]
+        new_gs = {"ef": res[4]} if use_ef else state.grad_sync
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats, opt_state=new_opt,
+                                  grad_sync=new_gs)
+        return new_state, metrics
+
     # -- ZeRO-1 sharded weight update ---------------------------------------
 
     def _zero1_step(self, state: TrainState, batch, rng):
@@ -260,10 +486,23 @@ class Trainer:
           of per-shard EMAs equals one EMA update with the weighted-mean
           batch statistics (the grad-accum argument, across space instead
           of time).
+
+        Wire compression (TrainConfig.wire_dtype) composes here: the
+        reduce-scatter half runs at bf16 or int8+error-feedback (one
+        residual per leaf per replica, parallel/grad_sync.py) — the grads
+        compress, the parameter all-gather stays exact. The residual is in
+        weight-scaled-gradient units (scatter operands are w-scaled sums).
         """
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
         axes = BATCH_AXES
         task = self.task
+        wire = self.config.wire_dtype
+        use_ef = wire == "int8"
+        if use_ef and not state.grad_sync:
+            raise ValueError(
+                "wire_dtype='int8' needs error-feedback buffers — build "
+                "the state via Trainer.init_state (TrainState.grad_sync is "
+                "empty)")
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
         outer = state  # static fields (apply_fn/tx) for the inner rebuild
 
@@ -272,10 +511,14 @@ class Trainer:
             lambda x: batch_spec(jnp.ndim(x)), batch)
         opt_specs = dp_flat_specs(state.opt_state)
 
-        def body(params, opt_state, stats, lbatch, key, step):
+        def body(params, opt_state, stats, lbatch, key, step, *maybe_ef):
             inner = outer.replace(step=step, params=params,
                                   batch_stats=stats, opt_state=opt_state)
             idx = lax.axis_index(axes)  # linear replica index over the axes
+            # per-leaf local residuals, (1, padded) -> (padded,)
+            ef_l = (jax.tree_util.tree_map(lambda r: r[0], maybe_ef[0])
+                    if use_ef else None)
+            treedef = jax.tree_util.tree_structure(params)
 
             def micro_grads(mb, k):
                 def loss_fn(p):
@@ -283,16 +526,32 @@ class Trainer:
 
                 return jax.grad(loss_fn, has_aux=True)(params)
 
-            def scatter(a):
-                # this replica's 1/N chunk of the cross-replica gradient sum
-                return psum_scatter(flatten_pad(a, n), axes)
+            def scatter_tree(gtree, ef_tree, combine=None, into=None):
+                """Per-leaf compressed reduce-scatter of the w-scaled grad
+                tree: returns (shard tree [combined into `into` via
+                `combine` when given], new ef tree)."""
+                g_leaves = treedef.flatten_up_to(gtree)
+                ef_leaves = (treedef.flatten_up_to(ef_tree) if use_ef
+                             else [None] * len(g_leaves))
+                into_leaves = (treedef.flatten_up_to(into)
+                               if into is not None else [None] * len(g_leaves))
+                outs, new_efs = [], []
+                for a, r, acc in zip(g_leaves, ef_leaves, into_leaves):
+                    s, nr = compressed_psum_scatter(
+                        flatten_pad(a.astype(jnp.float32), n), axes, n,
+                        wire, r)
+                    outs.append(acc + s if combine else s)
+                    new_efs.append(nr)
+                return (jax.tree_util.tree_unflatten(treedef, outs),
+                        (jax.tree_util.tree_unflatten(treedef, new_efs)
+                         if use_ef else None))
 
             if accum <= 1:
                 key = jax.random.fold_in(key, idx)
                 g, (m, stats_l) = micro_grads(lbatch, key)
                 w = m["weight"]
-                g_sum = jax.tree_util.tree_map(
-                    lambda a: scatter(w * a.astype(jnp.float32)), g)
+                g_sum, ef_l = scatter_tree(
+                    jax.tree_util.tree_map(lambda a: w * a, g), ef_l)
                 s_sum = (jax.tree_util.tree_map(
                     lambda s: w * s.astype(jnp.float32), stats_l)
                     if has_stats else stats)
@@ -319,20 +578,20 @@ class Trainer:
                 keys = jax.random.split(key, accum)
 
                 def mb_body(carry, xs):
-                    g_sum, s_sum, m_sum = carry
+                    g_sum, s_sum, m_sum, ef_c = carry
                     mb, k = xs
                     g, (m, stats_mb) = micro_grads(
                         mb, jax.random.fold_in(k, idx))
                     w = m["weight"]
-                    g_sum = jax.tree_util.tree_map(
-                        lambda a, b: a + scatter(w * b.astype(a.dtype)),
-                        g_sum, g)
+                    g_sum, ef_c = scatter_tree(
+                        jax.tree_util.tree_map(lambda b: w * b, g), ef_c,
+                        combine=True, into=g_sum)
                     if has_stats:
                         s_sum = jax.tree_util.tree_map(
                             lambda a, b: a + w * b.astype(a.dtype),
                             s_sum, stats_mb)
                     m_sum = add_metrics(m_sum, m)
-                    return (g_sum, s_sum, m_sum), None
+                    return (g_sum, s_sum, m_sum, ef_c), None
 
                 g0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(
@@ -340,8 +599,8 @@ class Trainer:
                     params)
                 s0 = jax.tree_util.tree_map(
                     lambda s: jnp.zeros(s.shape, jnp.float32), stats)
-                (g_sum, s_sum, m_local), _ = lax.scan(
-                    mb_body, (g0, s0, zero_metrics()),
+                (g_sum, s_sum, m_local, ef_l), _ = lax.scan(
+                    mb_body, (g0, s0, zero_metrics(), ef_l),
                     (micro_batches, keys))
 
             # fan the per-shard metric sums in (the reference's 3 epoch
@@ -377,17 +636,27 @@ class Trainer:
                     s_sum, stats)
             else:
                 new_stats = stats
-            return new_params, new_opt, new_stats, metrics
+            out = (new_params, new_opt, new_stats, metrics)
+            if use_ef:
+                out += (jax.tree_util.tree_map(lambda r: r[None], ef_l),)
+            return out
 
-        stepped = shard_map(
-            body, mesh,
-            in_specs=(rep, opt_specs, rep, batch_specs, rep, rep),
-            out_specs=(rep, opt_specs, rep, rep))
-        new_params, new_opt, new_stats, metrics = stepped(
-            state.params, state.opt_state, state.batch_stats, batch, rng,
-            state.step)
+        in_specs = (rep, opt_specs, rep, batch_specs, rep, rep)
+        out_specs = (rep, opt_specs, rep, rep)
+        args = [state.params, state.opt_state, state.batch_stats, batch,
+                rng, state.step]
+        if use_ef:
+            in_specs += (P(axes),)
+            out_specs += (P(axes),)
+            args.append(state.grad_sync["ef"])
+        stepped = shard_map(body, mesh, in_specs=in_specs,
+                            out_specs=out_specs)
+        res = stepped(*args)
+        new_params, new_opt, new_stats, metrics = res[:4]
+        new_gs = {"ef": res[4]} if use_ef else state.grad_sync
         new_state = state.replace(step=state.step + 1, params=new_params,
-                                  batch_stats=new_stats, opt_state=new_opt)
+                                  batch_stats=new_stats, opt_state=new_opt,
+                                  grad_sync=new_gs)
         return new_state, metrics
 
     def _eval_step_impl(self, state: TrainState, batch):
@@ -414,6 +683,11 @@ class Trainer:
         variables = model.init(init_rng, x, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
+        # int8 gradient wire: zero-initialized error-feedback residuals,
+        # attached AFTER mesh placement (they carry their own per-replica
+        # sharding; the rules would replicate them).
+        use_ef = (self.config.wire_dtype == "int8"
+                  and (self._zero1 or self._grad_sync))
         if self._zero1:
             # Params stay replicated (the DDP layout — zero1 shards only
             # the UPDATE); the optimizer state is born flat-padded-sharded
@@ -426,10 +700,18 @@ class Trainer:
                 batch_stats=batch_stats, opt_state=opt_state)
             placed = shard_pytree(state.replace(opt_state={}), self.mesh,
                                   self.rules)
-            return placed.replace(opt_state=opt_state)
+            placed = placed.replace(opt_state=opt_state)
+            if use_ef:
+                placed = placed.replace(grad_sync=ef_state_zero1(
+                    params, self.mesh, self._zero1_n))
+            return placed
         state = TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, batch_stats=batch_stats)
-        return shard_pytree(state, self.mesh, self.rules)
+        placed = shard_pytree(state, self.mesh, self.rules)
+        if use_ef:
+            placed = placed.replace(grad_sync=ef_state_bucketed(
+                params, self.mesh, self._zero1_n))
+        return placed
 
     # -- epoch loops -------------------------------------------------------
 

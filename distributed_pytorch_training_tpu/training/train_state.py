@@ -7,6 +7,7 @@ because bf16 needs no loss scaling (fp32-range exponent; SURVEY.md §2b row 4).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable
 
 import flax.struct
@@ -23,6 +24,11 @@ class TrainState:
     opt_state: Any
     apply_fn: Callable = flax.struct.field(pytree_node=False)
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    # Explicit-reducer side state (parallel/grad_sync.py): error-feedback
+    # residuals for the int8 gradient wire ({"ef": ...}, per-replica rows
+    # sharded over the batch axes). {} (no leaves) for every other mode —
+    # the pytree/checkpoint shape is unchanged unless int8 is engaged.
+    grad_sync: Any = dataclasses.field(default_factory=dict)
 
     @classmethod
     def create(cls, apply_fn: Callable, params: Any, tx: optax.GradientTransformation,

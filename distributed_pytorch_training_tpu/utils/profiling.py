@@ -55,3 +55,14 @@ class StepProfiler:
             self._active = False
             self._done = True
             log_main(f"Profiler trace written to {self.log_dir}")
+
+    # Context-manager protocol: an aborted profiled run (exception mid-
+    # epoch) must not leave the jax profiler session open — a leaked
+    # session makes every later start_trace in the process fail and drops
+    # the partial trace on the floor. `with StepProfiler(...) as p:` closes
+    # on ANY exit path.
+    def __enter__(self) -> "StepProfiler":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.close()

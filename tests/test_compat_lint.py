@@ -1,0 +1,58 @@
+"""Compat lint (ROADMAP "jax version skew"): every shard_map in the repo
+must go through the one version-compat shim, `parallel/collectives.py
+shard_map` — the entry point moved (jax.experimental.shard_map ->
+jax.shard_map) and the replication-check flag was renamed (check_rep ->
+check_vma) across the jax versions this code runs under. A direct import
+anywhere else works on ONE jax version and breaks on the next; this tier-1
+test fails the moment a new violation lands.
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "distributed_pytorch_training_tpu"
+
+# The one allowed home of the raw entry point.
+SHIM = PKG / "parallel" / "collectives.py"
+
+# Direct uses of the raw entry points, in any of the forms jax has offered:
+#   jax.shard_map(...), jax.experimental.shard_map.shard_map(...),
+#   from jax.experimental.shard_map import shard_map,
+#   from jax.experimental import shard_map
+_DIRECT_RE = re.compile(
+    r"jax\.shard_map"
+    r"|jax\.experimental\.shard_map"
+    r"|from\s+jax\.experimental\s+import\s+([\w\s,]*\b)?shard_map")
+
+
+def _strip_comments(src: str) -> str:
+    """Drop #-comments so prose mentioning the entry points doesn't trip
+    the lint (docstrings still count: code examples there would be copied)."""
+    return "\n".join(line.split("#", 1)[0] for line in src.splitlines())
+
+
+def test_no_direct_shard_map_outside_collectives_shim():
+    offenders = []
+    files = sorted(PKG.rglob("*.py")) + sorted(REPO.glob("*.py"))
+    for path in files:
+        if path.resolve() == SHIM.resolve():
+            continue
+        for i, line in enumerate(
+                _strip_comments(path.read_text()).splitlines(), 1):
+            if _DIRECT_RE.search(line):
+                offenders.append(f"{path.relative_to(REPO)}:{i}: "
+                                 f"{line.strip()}")
+    assert not offenders, (
+        "direct jax shard_map entry-point use outside the "
+        "parallel/collectives.py shim (import `shard_map` from "
+        "distributed_pytorch_training_tpu.parallel instead):\n  "
+        + "\n  ".join(offenders))
+
+
+def test_shim_itself_still_wraps_the_raw_entry_points():
+    """The lint is only meaningful while the shim really is the compat
+    layer: it must reference both historical entry points."""
+    src = SHIM.read_text()
+    assert "jax.shard_map" in src
+    assert "jax.experimental.shard_map" in src

@@ -208,6 +208,74 @@ def test_loader_early_abandon_does_not_leak_thread(mesh8):
     assert threading.active_count() <= before + 1
 
 
+class TestPythonFallbackLoader:
+    """The pure-Python prefetch epoch (`ShardedLoader._python_epoch`) —
+    what every host without the native library runs. The native path covers
+    most CI environments, so these tests force the fallback explicitly."""
+
+    @pytest.fixture(autouse=True)
+    def _force_python_path(self, monkeypatch):
+        from distributed_pytorch_training_tpu import native
+
+        monkeypatch.setattr(native, "is_available", lambda: False)
+
+    def test_padded_final_batch_weights(self, mesh8):
+        # 100 samples, global batch 32: the 4th batch carries 4 real rows
+        # and 28 zero-weight pads (drop_last=False, ref :139) — through the
+        # QUEUE path, not just the sampler.
+        ds = synthetic_image_dataset(100, seed=0)
+        loader = ShardedLoader(ds, mesh8, per_device_batch=4, shuffle=False)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 4
+        w_last = np.asarray(batches[-1]["weight"])
+        assert float(w_last.sum()) == 4.0
+        assert set(np.unique(w_last)) == {0.0, 1.0}
+        total = sum(float(np.asarray(b["weight"]).sum()) for b in batches)
+        assert total == 100.0
+        # the padded batch keeps the full static shape (one XLA program
+        # serves every step)
+        assert batches[-1]["image"].shape == (32, 32, 32, 3)
+
+    def test_prefetch_thread_shuts_down_on_abandonment(self, mesh8):
+        import threading
+        import time as _t
+
+        ds = synthetic_image_dataset(512, seed=0)
+        loader = ShardedLoader(ds, mesh8, per_device_batch=2, shuffle=False,
+                               prefetch=2)
+        before = set(threading.enumerate())
+        it = loader.epoch(0)
+        next(it)  # producer thread is live and the queue is filling
+        it.close()  # GeneratorExit -> stop.set() + drain + join
+        deadline = _t.time() + 6.0
+        while _t.time() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t not in before and t.is_alive()]
+            if not leaked:
+                break
+            _t.sleep(0.05)
+        assert not leaked, f"producer thread(s) survived abandonment: {leaked}"
+
+    def test_full_epoch_then_threads_retire(self, mesh8):
+        import threading
+        import time as _t
+
+        ds = synthetic_image_dataset(64, seed=0)
+        loader = ShardedLoader(ds, mesh8, per_device_batch=2, shuffle=True)
+        before = set(threading.enumerate())
+        seen = sum(float(np.asarray(b["weight"]).sum())
+                   for b in loader.epoch(1))
+        assert seen == 64.0
+        deadline = _t.time() + 6.0
+        while _t.time() < deadline:
+            if not [t for t in threading.enumerate()
+                    if t not in before and t.is_alive()]:
+                break
+            _t.sleep(0.05)
+        assert not [t for t in threading.enumerate()
+                    if t not in before and t.is_alive()]
+
+
 class TestRealDataPipelines:
     """The r3 verdict's missing real-data paths (VERDICT r3 #3): packed
     ImageNet from disk (memmapped, no --synthetic) and tokenized LM corpora

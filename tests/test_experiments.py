@@ -109,6 +109,65 @@ def test_experiment_gradsync_smoke(capsys, tmp_path):
 
 
 @pytest.mark.slow
+def test_experiment_grad_sync_smoke(capsys):
+    """The explicit-reducer arm: every mode row carries the census columns
+    (engagement proof) and the bucketed rows show the compressed wire."""
+    _run_experiment(["grad_sync", "--model", "gpt2_124m", "--lm-tiny",
+                     "--seq-len", "32", "--bucket-cap-mb", "25"] + _SMOKE)
+    out = capsys.readouterr().out
+    assert "grad_collectives" in out
+    assert "bucketed_bf16" in out and "bucketed_int8" in out
+    assert "exposed_comm_pct" in out
+
+
+def test_comm_overlap_split_math(tmp_path):
+    """Interval arithmetic of the exposed-vs-hidden split on a synthetic
+    trace: one collective fully covered by compute, one half covered, one
+    fully exposed."""
+    import gzip
+    import json
+
+    from distributed_pytorch_training_tpu.experiments.trace_analysis import (
+        comm_overlap_split,
+    )
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        # compute lane: [0, 100) and [200, 250)
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 0,
+         "dur": 100},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.2", "ts": 200,
+         "dur": 50},
+        # hidden: all-reduce [10, 60) inside compute
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce.1", "ts": 10,
+         "dur": 50},
+        # half hidden: [80, 120) overlaps compute only until 100
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-gather.1", "ts": 80,
+         "dur": 40},
+        # fully exposed: [130, 160)
+        {"ph": "X", "pid": 1, "tid": 2, "name": "reduce-scatter.1",
+         "ts": 130, "dur": 30},
+        # completion markers must not count
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce-done.1",
+         "ts": 160, "dur": 500},
+    ]
+    d = tmp_path / "plugins"
+    d.mkdir()
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    split = comm_overlap_split(str(tmp_path))
+    assert split["collective_us"] == 120.0
+    assert split["hidden_us"] == 70.0   # 50 + 20
+    assert split["exposed_us"] == 50.0  # 20 + 30
+    assert split["exposed_frac_pct"] == round(100.0 * 50 / 120, 2)
+
+
+@pytest.mark.slow
 def test_experiment_pipeline_smoke(capsys):
     _run_experiment(["pipeline"] + _SMOKE)
     out = capsys.readouterr().out

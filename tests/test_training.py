@@ -470,3 +470,37 @@ class TestSeedDeterminism:
         a = self._run(mesh8, seed=42)
         c = self._run(mesh8, seed=43)
         assert a != c
+
+
+class TestStepProfilerLifecycle:
+    """StepProfiler must never leak an open jax.profiler session: a leaked
+    session fails every later start_trace in the process and drops the
+    partial trace (the train.py epoch loop context-manages it)."""
+
+    def test_closes_on_exception(self, tmp_path):
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+
+        prof = StepProfiler(str(tmp_path / "t1"), 0, 5)
+        with pytest.raises(RuntimeError, match="mid-epoch boom"):
+            with prof:
+                prof(0)  # enters the window -> start_trace fires
+                assert prof._active
+                raise RuntimeError("mid-epoch boom")
+        assert not prof._active
+        # the session really closed: a fresh trace can start (an open
+        # session would raise here)
+        jax.profiler.start_trace(str(tmp_path / "t2"))
+        jax.profiler.stop_trace()
+
+    def test_close_idempotent_and_noop_outside_window(self, tmp_path):
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+
+        with StepProfiler(str(tmp_path / "t3"), 5, 8) as prof:
+            prof(0)  # before the window: no trace started
+            assert not prof._active
+        prof.close()  # double close is safe
+        assert not prof._active

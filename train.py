@@ -361,12 +361,24 @@ def _run(args, guard):
                       TrainConfig(per_device_batch=args.batch_size,
                                   print_freq=args.print_freq, seed=args.seed,
                                   bf16=args.amp, grad_accum=args.grad_accum,
-                                  zero1=args.zero1),
+                                  zero1=args.zero1,
+                                  bucket_cap_mb=args.bucket_cap_mb,
+                                  wire_dtype=args.wire_dtype,
+                                  overlap_grad_sync=not
+                                  args.no_overlap_grad_sync),
                       rules=rules)
     if args.zero1 and n_batch_shards > 1:
         log_main(f"ZeRO-1: weight update sharded {n_batch_shards}-way over "
                  "the batch axes (reduce-scatter grads -> 1/N optimizer "
-                 "update -> all-gather params)")
+                 "update -> all-gather params"
+                 + (f"; {args.wire_dtype} gradient wire"
+                    if args.wire_dtype != "fp32" else "") + ")")
+    elif trainer._grad_sync:
+        log_main(f"Gradient sync: explicit bucketed reducer over "
+                 f"{n_batch_shards} shards — bucket_cap_mb="
+                 f"{args.bucket_cap_mb or 'inf (one bucket)'}, "
+                 f"wire={args.wire_dtype}, overlap="
+                 f"{'off' if args.no_overlap_grad_sync else 'on'}")
 
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
@@ -378,6 +390,13 @@ def _run(args, guard):
                  f"(+{pad_extra:,} vocab-pad rows for TP)")
     else:
         log_main(f"Model {args.model}: {n_params:,} params")
+    if trainer._grad_sync:
+        from distributed_pytorch_training_tpu.parallel.grad_sync import (
+            build_bucket_plan,
+        )
+        plan = build_bucket_plan(state.params, args.bucket_cap_mb)
+        log_main(f"Gradient sync: {plan.n_buckets} bucket(s) over "
+                 f"{plan.total_bytes / 2 ** 20:.1f} MB of fp32 gradient")
 
     # MFU in the step log (TPU only — needs a known chip peak): analytic
     # matmul/conv FLOPs of one train step, traced once on a peeked batch.
@@ -456,63 +475,67 @@ def _run(args, guard):
         start, stop = (int(x) for x in args.profile_steps.split(","))
         profiler = StepProfiler(args.profile_dir, start, stop)
 
-    for epoch in range(start_epoch, args.epochs):  # ref :356
-        counts = samples_per_step_list(len(train_ds), global_batch,
-                                       steps_per_epoch, args.drop_last)
-        state, train_loss, train_acc, epoch_time, steps_done = \
-            trainer.train_epoch(
-                state, train_loader.epoch(epoch, start_step=start_step),
-                epoch, steps_per_epoch,
-                samples_per_step=counts[start_step:], step_hook=profiler,
-                start_step=start_step,
-                stop_fn=lambda: guard.should_stop)
-        abs_step = start_step + steps_done
-        start_step = 0
+    # Context-managed: an exception (or preemption-path raise) mid-epoch
+    # must still stop an open jax.profiler session — a leaked session
+    # fails every later start_trace in the process and loses the trace.
+    import contextlib
 
-        if guard.should_stop and abs_step < steps_per_epoch:
-            # Preempted MID-epoch: persist (epoch, step) immediately — a
-            # resume replays nothing (the r3 story lost up to an epoch,
-            # VERDICT r3 #5). No CSV row: the epoch is incomplete.
-            if ckpt:
-                ckpt.save(epoch * steps_per_epoch + abs_step, state,
-                          wait=True, epoch=epoch, step_in_epoch=abs_step)
-                log_main(f"Preempted: checkpointed epoch {epoch} step "
-                         f"{abs_step}/{steps_per_epoch}; relaunch with "
-                         "--resume to continue mid-epoch")
-            else:
-                log_main("Preempted: stopping (no --checkpoint-dir, "
-                         "nothing persisted beyond the metrics CSV)")
-            break
+    with profiler if profiler is not None else contextlib.nullcontext():
+        for epoch in range(start_epoch, args.epochs):  # ref :356
+            counts = samples_per_step_list(len(train_ds), global_batch,
+                                           steps_per_epoch, args.drop_last)
+            state, train_loss, train_acc, epoch_time, steps_done = \
+                trainer.train_epoch(
+                    state, train_loader.epoch(epoch, start_step=start_step),
+                    epoch, steps_per_epoch,
+                    samples_per_step=counts[start_step:], step_hook=profiler,
+                    start_step=start_step,
+                    stop_fn=lambda: guard.should_stop)
+            abs_step = start_step + steps_done
+            start_step = 0
 
-        val_loss, val_acc = trainer.evaluate(state, val_loader.epoch(0))
+            if guard.should_stop and abs_step < steps_per_epoch:
+                # Preempted MID-epoch: persist (epoch, step) immediately — a
+                # resume replays nothing (the r3 story lost up to an epoch,
+                # VERDICT r3 #5). No CSV row: the epoch is incomplete.
+                if ckpt:
+                    ckpt.save(epoch * steps_per_epoch + abs_step, state,
+                              wait=True, epoch=epoch, step_in_epoch=abs_step)
+                    log_main(f"Preempted: checkpointed epoch {epoch} step "
+                             f"{abs_step}/{steps_per_epoch}; relaunch with "
+                             "--resume to continue mid-epoch")
+                else:
+                    log_main("Preempted: stopping (no --checkpoint-dir, "
+                             "nothing persisted beyond the metrics CSV)")
+                break
 
-        # Epoch summary + CSV row (ref :373-384, formats identical).
-        log_main(
-            f"[Epoch {epoch + 1}/{args.epochs}] "
-            f"Train: loss={train_loss:.4f}, acc={train_acc:.2f}% | "
-            f"Val: loss={val_loss:.4f}, acc={val_acc:.2f}% | "
-            f"Epoch time: {epoch_time:.2f}s"
-        )
-        csv.append(epoch, train_loss, train_acc, val_loss, val_acc, epoch_time)
+            val_loss, val_acc = trainer.evaluate(state, val_loader.epoch(0))
 
-        if ckpt and (epoch + 1) % args.checkpoint_every == 0:
-            ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1)
+            # Epoch summary + CSV row (ref :373-384, formats identical).
+            log_main(
+                f"[Epoch {epoch + 1}/{args.epochs}] "
+                f"Train: loss={train_loss:.4f}, acc={train_acc:.2f}% | "
+                f"Val: loss={val_loss:.4f}, acc={val_acc:.2f}% | "
+                f"Epoch time: {epoch_time:.2f}s"
+            )
+            csv.append(epoch, train_loss, train_acc, val_loss, val_acc, epoch_time)
 
-        if guard.should_stop:
-            if ckpt:
-                if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
-                    ckpt.save((epoch + 1) * steps_per_epoch, state,
-                              epoch=epoch + 1)
-                ckpt.wait()
-                log_main(f"Preempted: checkpointed epoch {epoch + 1}; "
-                         "relaunch with --resume to continue")
-            else:
-                log_main("Preempted: stopping (no --checkpoint-dir, "
-                         "nothing persisted beyond the metrics CSV)")
-            break
+            if ckpt and (epoch + 1) % args.checkpoint_every == 0:
+                ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1)
 
-    if profiler:
-        profiler.close()
+            if guard.should_stop:
+                if ckpt:
+                    if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
+                        ckpt.save((epoch + 1) * steps_per_epoch, state,
+                                  epoch=epoch + 1)
+                    ckpt.wait()
+                    log_main(f"Preempted: checkpointed epoch {epoch + 1}; "
+                             "relaunch with --resume to continue")
+                else:
+                    log_main("Preempted: stopping (no --checkpoint-dir, "
+                             "nothing persisted beyond the metrics CSV)")
+                break
+
     if ckpt:
         ckpt.wait()  # finalize async writes before exit
         ckpt.close()
