@@ -943,9 +943,22 @@ def _bench(args):
         # wall_s lands in the history row: it is what makes the next run's
         # cost gate empirical instead of worst-case (_est_for)
         r["wall_s"] = round(time.perf_counter() - t0, 1)
+        # the per-arm HLO contract verdict (analysis/hlo_rules.py) rides
+        # every history row; a failing arm is loud in the log but still a
+        # measurement — the contract gate is `analysis check`, not bench
+        contract = (r.get("contracts") or {}).get("pass")
+        c_str = {True: "ok", False: "VIOLATED", None: "unchecked"}[contract]
         _log(f"bench: {name} done in {r['wall_s']:.1f}s: "
              f"{r['samples_per_sec_chip']:.0f} samples/s/chip, "
-             f"mfu={r['mfu_pct']}%")
+             f"mfu={r['mfu_pct']}%, contracts={c_str}")
+        if contract is False:
+            _log(f"bench: {name} CONTRACT VIOLATIONS: "
+                 f"{r['contracts']['violations']}")
+        elif contract is None:
+            # a broken CHECKER must be distinguishable from a benign skip
+            why = (r.get("contracts") or {}).get(
+                "error", "no contracts recorded")
+            _log(f"bench: {name} contract checker did not run: {why}")
         return r
 
     def result_dict(headline, fp32, extras, skipped):

@@ -1,0 +1,28 @@
+"""Static analysis for the parallelism contracts this repo promises.
+
+The reference DDP script gets its correctness guarantees implicitly from
+torch's reducer; the TPU port makes every parallelism decision explicit
+(zero1, bucketed grad-sync, wire compression) — so the guarantees must be
+*checked* explicitly too. Two engines, one CLI:
+
+* **HLO contract checker** (`hlo_rules`, `contracts`): declarative
+  `Contract` objects lowered on the canonical config matrix (dp, zero1,
+  grad_sync x {fp32, bf16, int8}, grad-accum on/off) and evaluated by
+  rules over the optimized / pre-optimization HLO text — collective
+  counts, wire dtypes, donation aliasing, host transfers, sharded
+  optimizer state.
+* **AST lint engine** (`ast_rules`): an `ast`-visitor framework for the
+  source-level contracts — shard_map only via the compat shim, no impure
+  host calls inside traced bodies, no device syncs in step paths, axis
+  names only from the `parallel/mesh.py` registry.
+
+Run both: ``python -m distributed_pytorch_training_tpu.analysis check``
+(or the ``analysis`` console script). Every rule ships with a mutation
+test (a synthetic violation it must flag) so the analyzer itself is
+verified, not just green — see tests/test_analysis_*.py.
+"""
+
+from .contracts import (  # noqa: F401
+    CONTRACT_MATRIX, Contract, Finding, Rule, WIRE_MODES,
+    collectives_per_bucket, iter_rules, rule,
+)

@@ -1,0 +1,138 @@
+"""``python -m distributed_pytorch_training_tpu.analysis check`` — run the
+parallelism contract checker (HLO engine over the canonical config matrix +
+AST lint engine over the repo source) and exit nonzero on any finding.
+
+Also installed as the ``analysis`` console script (pyproject.toml).
+
+Flags:
+  --json             machine-readable report on stdout
+  --rules a,b        run only the named rules (see --list)
+  --ast-only         skip the HLO matrix (no jax / device init — fast lint)
+  --contracts a,b    evaluate only the named contracts from the matrix
+  --list             print the rule catalog (name, kind, rationale) and exit
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _ensure_test_mesh() -> None:
+    """Standalone CLI runs need a multi-device mesh for the zero1/grad_sync
+    contracts to engage. On CPU (or unset platform) request the 8-device
+    virtual mesh — the tests/conftest.py recipe. The image's sitecustomize
+    imports jax at interpreter startup, but XLA backend init is LAZY, so
+    the env mutations still take effect as long as no jax.devices() call
+    has happened yet; callers that already initialized a backend (the
+    tier-1 in-process test, a real TPU run) keep their devices."""
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform not in ("", "cpu"):
+        return  # real accelerator run: keep its devices
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        import jax
+
+        from ..runtime import honor_platform_env
+
+        honor_platform_env()  # re-assert cpu via the config API
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        pass  # older jax: the XLA_FLAGS fallback above provides the devices
+    except Exception:  # noqa: BLE001 - backend already up: nothing to do
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="analysis",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("command", choices=["check"],
+                   help="'check' runs both engines")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule names to run (default: all)")
+    p.add_argument("--contracts", default=None,
+                   help="comma-separated contract names from the matrix "
+                        "(default: all)")
+    p.add_argument("--ast-only", action="store_true",
+                   help="skip the HLO config matrix (no jax init)")
+    p.add_argument("--list", action="store_true", dest="list_rules",
+                   help="print the rule catalog and exit")
+    args = p.parse_args(argv)
+
+    from .contracts import CONTRACT_MATRIX, get_contract, iter_rules
+
+    try:
+        rule_names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                      if args.rules else None)
+        rules = iter_rules(names=rule_names)
+    except KeyError as e:
+        print(f"analysis: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name} [{r.kind}]\n  {r.description}\n  why: "
+                  f"{r.rationale}\n")
+        return 0
+
+    ast_rule_names = [r.name for r in rules if r.kind == "ast"]
+    hlo_rule_names = [r.name for r in rules if r.kind == "hlo"]
+
+    findings = []
+    contract_status = {}
+
+    if ast_rule_names:
+        from .ast_rules import run_ast_rules
+
+        findings += run_ast_rules(rules=ast_rule_names)
+
+    if hlo_rule_names and not args.ast_only:
+        try:
+            contracts = ([get_contract(c.strip())
+                          for c in args.contracts.split(",") if c.strip()]
+                         if args.contracts else CONTRACT_MATRIX)
+        except KeyError as e:
+            print(f"analysis: {e.args[0]}", file=sys.stderr)
+            return 2
+        _ensure_test_mesh()
+        from .hlo_rules import run_contract_matrix
+
+        hlo_findings, contract_status = run_contract_matrix(
+            contracts=contracts, rules=hlo_rule_names)
+        findings += hlo_findings
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": not findings,
+            "n_findings": len(findings),
+            "findings": [f.as_dict() for f in findings],
+            "contracts": contract_status,
+            "rules_run": [r.name for r in rules],
+        }, indent=2, sort_keys=True))
+    else:
+        for name, status in sorted(contract_status.items()):
+            print(f"contract {name}: {status}")
+        for f in findings:
+            print(str(f))
+        print(f"analysis check: {len(findings)} finding(s) from "
+              f"{len(rules)} rule(s)"
+              + (f", {len(contract_status)} contract(s)"
+                 if contract_status else ""))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,414 @@
+"""AST lint engine: source-level parallelism contracts, checked on real
+syntax trees instead of regexes.
+
+The predecessor (tests/test_compat_lint.py's regex) fired on *mentions* of
+the shard_map entry points inside docstrings and string literals — prose
+about the rule tripped the rule. An `ast` visitor only sees real imports,
+attribute accesses, and calls, so the false-positive class is structural,
+not patched around.
+
+Every rule reports `Finding`s with file:line locations. Suppression is
+per-line: append ``# analysis: disable=<rule-name>`` (or ``disable=all``)
+to the offending line — meant for experiment branches that knowingly break
+a contract, and visible in review precisely because it sits on the line.
+
+The engine is dependency-free by design (no jax import): linting the repo
+must never require initializing a backend. The axis-name registry is
+therefore a literal copy of `parallel/mesh.py`'s AXIS_ORDER; a tier-1 test
+asserts the two stay identical.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .contracts import Finding, rule
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+PKG_ROOT = Path(__file__).resolve().parent.parent
+
+# The one allowed home of the raw shard_map entry points (the version-compat
+# shim — ROADMAP "jax version skew").
+SHARD_MAP_SHIM = "parallel/collectives.py"
+
+# Mirror of parallel/mesh.py AXIS_NAMES (kept import-free; test-pinned).
+AXIS_NAMES = frozenset({"data", "fsdp", "model", "seq", "pipe", "expert"})
+
+# Collective-call names whose axis argument must come from the registry.
+_AXIS_CALLS = frozenset({
+    "psum", "pmean", "pmax", "psum_scatter", "all_gather", "all_to_all",
+    "ppermute", "ppermute_ring", "axis_index", "axis_size",
+})
+
+_DISABLE_RE = re.compile(r"#\s*analysis:\s*disable=([\w\-,\s]+)")
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed source file, shared across the rules that visit it."""
+
+    path: Path
+    relpath: str
+    tree: ast.Module
+    lines: List[str]
+    # alias maps built once per file (imports are module-level in this repo)
+    modules: Dict[str, str] = dataclasses.field(default_factory=dict)
+    members: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: Path, repo: Path = REPO_ROOT) -> "FileContext":
+        src = path.read_text()
+        try:
+            rel = path.resolve().relative_to(repo).as_posix()
+        except ValueError:  # outside the repo (synthetic test files)
+            rel = path.as_posix()
+        ctx = cls(path=path, relpath=rel,
+                  tree=ast.parse(src, filename=str(path)),
+                  lines=src.splitlines())
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    ctx.modules[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name != "*":
+                        ctx.members[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+        return ctx
+
+    def loc(self, node: ast.AST) -> str:
+        return f"{self.relpath}:{getattr(node, 'lineno', 0)}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain with import aliases
+        expanded: `np.random.rand` -> "numpy.random.rand" under
+        `import numpy as np`; `shard_map` -> its from-import source."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = node.id
+        if head in self.members:
+            parts.append(self.members[head])
+        elif head in self.modules:
+            parts.append(self.modules[head])
+        else:
+            parts.append(head)
+        return ".".join(reversed(parts))
+
+    def suppressed(self, finding: Finding) -> bool:
+        try:
+            lineno = int(finding.location.rsplit(":", 1)[1])
+            line = self.lines[lineno - 1]
+        except (IndexError, ValueError):
+            return False
+        m = _DISABLE_RE.search(line)
+        if not m:
+            return False
+        names = {n.strip() for n in m.group(1).split(",")}
+        return "all" in names or finding.rule in names
+
+
+# ---------------------------------------------------------------------------
+# Shared traced-function discovery (rules 2 and 3)
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = ("jax.jit", "jax.pmap")
+
+
+def _is_jit_name(resolved: Optional[str]) -> bool:
+    return resolved in _JIT_NAMES
+
+
+def _is_shard_map_name(resolved: Optional[str]) -> bool:
+    return bool(resolved) and resolved.split(".")[-1] == "shard_map"
+
+
+def traced_function_names(ctx: FileContext) -> Set[str]:
+    """Names of functions this file hands to jax.jit / shard_map (by call
+    argument or decorator) — their bodies, including nested defs, run under
+    tracing. A per-file heuristic: good enough because the repo's traced
+    entry points are always wrapped in the module that defines them."""
+    traced: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = ctx.resolve(node.func)
+            if (_is_jit_name(fn) or _is_shard_map_name(fn)) and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    traced.add(target.id)
+                elif isinstance(target, ast.Attribute):
+                    traced.add(target.attr)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                fn = ctx.resolve(base)
+                if _is_jit_name(fn) or _is_shard_map_name(fn):
+                    traced.add(node.name)
+                # @partial(jax.jit, ...) / @functools.partial(shard_map, ...)
+                if isinstance(dec, ast.Call) and fn and \
+                        fn.split(".")[-1] == "partial" and dec.args:
+                    inner = ctx.resolve(dec.args[0])
+                    if _is_jit_name(inner) or _is_shard_map_name(inner):
+                        traced.add(node.name)
+    return traced
+
+
+def _traced_defs(ctx: FileContext, extra_names: Iterable[str] = ()
+                 ) -> List[ast.FunctionDef]:
+    names = traced_function_names(ctx) | set(extra_names)
+    return [n for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.FunctionDef) and n.name in names]
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: shard_map only via the compat shim
+# ---------------------------------------------------------------------------
+
+
+@rule("shard-map-shim-only", "ast",
+      "shard_map is used only through the parallel/collectives.py shim",
+      "the raw entry point moved (jax.experimental.shard_map -> "
+      "jax.shard_map) and its replication flag was renamed (check_rep -> "
+      "check_vma) across the jax versions this code runs under; a direct "
+      "use works on ONE version and breaks on the next (ROADMAP 'jax "
+      "version skew'). Unlike the old regex lint, mentions in docstrings "
+      "and strings do not count — only real imports, attribute accesses, "
+      "and kwargs.")
+def check_shard_map_shim(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath.endswith(SHARD_MAP_SHIM):
+        return []
+    name = "shard-map-shim-only"
+    out: List[Finding] = []
+    # `jax.experimental.shard_map.shard_map` is ONE use: ast.walk visits
+    # the outer Attribute before its inner chain, so flag the outer node
+    # and skip its descendants (else the same line reports twice).
+    inner_seen: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("jax.experimental.shard_map"):
+                    out.append(Finding(
+                        name, f"direct import of {a.name} (import "
+                        "`shard_map` from "
+                        "distributed_pytorch_training_tpu.parallel)",
+                        ctx.loc(node)))
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            if node.module == "jax.experimental.shard_map" or (
+                    node.module in ("jax", "jax.experimental")
+                    and any(a.name == "shard_map" for a in node.names)):
+                out.append(Finding(
+                    name, f"direct shard_map import from {node.module} "
+                    "(use the parallel/collectives.py shim)",
+                    ctx.loc(node)))
+        elif isinstance(node, ast.Attribute):
+            if id(node) in inner_seen:
+                continue
+            resolved = ctx.resolve(node) or ""
+            if resolved in ("jax.shard_map",
+                            "jax.experimental.shard_map") or \
+                    resolved.startswith("jax.experimental.shard_map."):
+                out.append(Finding(
+                    name, f"direct use of {resolved} (use the "
+                    "parallel/collectives.py shim)", ctx.loc(node)))
+                inner_seen.update(
+                    id(sub) for sub in ast.walk(node) if sub is not node)
+        elif isinstance(node, ast.Call):
+            fn = ctx.resolve(node.func)
+            if _is_shard_map_name(fn):
+                bad = [k.arg for k in node.keywords
+                       if k.arg in ("check_rep", "check_vma")]
+                if bad:
+                    out.append(Finding(
+                        name, f"shard_map called with {bad} — the shim "
+                        "owns the replication-check flag (its NAME is the "
+                        "version skew)", ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: no impure host calls inside traced bodies
+# ---------------------------------------------------------------------------
+
+# Impure prefixes: calls whose result differs run-to-run. Pure numpy shape
+# math (np.prod(np.shape(x))) is trace-time constant folding and stays
+# legal; np.random/stdlib random/time bake ONE trace-time draw into the
+# compiled program silently — the program replays it forever.
+_IMPURE_PREFIXES = ("time.", "random.", "numpy.random.")
+
+
+@rule("no-impure-calls-in-traced", "ast",
+      "no time/random/np.random calls inside jit/shard_map-traced bodies",
+      "an impure host call inside a traced body executes ONCE at trace "
+      "time and its result is baked into the compiled program as a "
+      "constant — every step replays the same 'random' draw or timestamp, "
+      "silently. (Pure numpy shape math is trace-time constant folding "
+      "and is allowed.)")
+def check_impure_in_traced(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for fndef in _traced_defs(ctx):
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            resolved = ctx.resolve(node.func)
+            if not resolved:
+                continue
+            if any(resolved == p[:-1] or resolved.startswith(p)
+                   for p in _IMPURE_PREFIXES):
+                out.append(Finding(
+                    "no-impure-calls-in-traced",
+                    f"{resolved}() inside traced function "
+                    f"`{fndef.name}` — executes once at trace time, baked "
+                    "into the program as a constant (use jax.random / "
+                    "device-side state)", ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: no device syncs in training/loop.py step paths
+# ---------------------------------------------------------------------------
+
+_SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
+
+
+@rule("no-host-sync-in-step", "ast",
+      "no .item()/float()/device_get syncs inside training/loop.py step "
+      "paths",
+      "the reference's per-step .item() was its throughput bottleneck "
+      "(train_ddp.py:217); the loop design fetches only at print "
+      "boundaries. A sync creeping back into a step function stalls the "
+      "device once per step — invisible in tests, ruinous at scale.")
+def check_host_sync_in_step(ctx: FileContext) -> List[Finding]:
+    if not ctx.relpath.endswith("training/loop.py"):
+        return []
+    name = "no-host-sync-in-step"
+    step_names = {n.name for n in ast.walk(ctx.tree)
+                  if isinstance(n, ast.FunctionDef)
+                  and (n.name.endswith("_step") or
+                       n.name.endswith("_step_impl"))}
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for fndef in _traced_defs(ctx, extra_names=step_names):
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    name, f".item() inside step path `{fndef.name}` — a "
+                    "per-step device sync", ctx.loc(node)))
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved in _SYNC_CALLS:
+                out.append(Finding(
+                    name, f"{resolved}() inside step path `{fndef.name}` — "
+                    "a per-step device sync", ctx.loc(node)))
+            elif resolved in ("float", "int") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                out.append(Finding(
+                    name, f"{resolved}() on a device value inside step "
+                    f"path `{fndef.name}` — forces a host fetch",
+                    ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: axis-name literals only from the mesh registry
+# ---------------------------------------------------------------------------
+
+
+def _literal_strings(node: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """String constants in an axis-argument position: the constant itself
+    or the elements of a tuple/list of constants."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [(node.value, node)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [(e.value, e) for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+@rule("axis-name-registry", "ast",
+      "mesh axis names appear as string literals only in parallel/mesh.py",
+      "every axis literal outside the registry is a rename hazard: "
+      "collectives psum over 'data' while the mesh was built with the "
+      "constants, and a registry change silently strands the literal — "
+      "the axis typo failure mode _axes_present guards at runtime, "
+      "caught at lint time instead.")
+def check_axis_name_registry(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath.endswith("parallel/mesh.py"):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func) or ""
+        base = resolved.split(".")[-1]
+        candidates: List[Tuple[str, ast.AST]] = []
+        if base in ("PartitionSpec", "P"):
+            for arg in node.args:
+                candidates += _literal_strings(arg)
+        elif base in _AXIS_CALLS and len(node.args) >= 2:
+            candidates += _literal_strings(node.args[1])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                candidates += _literal_strings(kw.value)
+        for value, lit in candidates:
+            if value in AXIS_NAMES:
+                out.append(Finding(
+                    "axis-name-registry",
+                    f"axis name {value!r} as a string literal in "
+                    f"{base}(...) — import the constant from "
+                    "parallel/mesh.py (DATA/FSDP/MODEL/SEQ/PIPE/EXPERT "
+                    "or BATCH_AXES)", ctx.loc(lit)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def iter_source_files(repo: Path = REPO_ROOT) -> List[Path]:
+    """The linted set: the package plus repo-top-level scripts — the same
+    scope the old regex lint covered. Tests are exempt (they hold the
+    synthetic violations the mutation tests feed the rules)."""
+    pkg = repo / "distributed_pytorch_training_tpu"
+    return sorted(pkg.rglob("*.py")) + sorted(repo.glob("*.py"))
+
+
+def run_ast_rules(files: Optional[Iterable[Path]] = None,
+                  rules: Optional[List[str]] = None,
+                  repo: Path = REPO_ROOT) -> List[Finding]:
+    """Run every (selected) AST rule over `files` (default: the repo set).
+    Files that fail to parse produce a finding instead of crashing the
+    run — a syntax error is a finding, not an analyzer failure."""
+    from .contracts import iter_rules
+
+    selected = iter_rules(kind="ast", names=rules)
+    findings: List[Finding] = []
+    for path in (files if files is not None else iter_source_files(repo)):
+        path = Path(path)
+        try:
+            ctx = FileContext.parse(path, repo=repo)
+        except (SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                "parse-error", f"could not parse: {e}",
+                str(path)))
+            continue
+        for r in selected:
+            for f in r.check(ctx):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+    return findings

@@ -1,0 +1,176 @@
+"""Framework for the parallelism contract checker: findings, the rule
+registry, and the declarative config matrix the HLO engine evaluates.
+
+A `Rule` is one named, documented check; `Finding` is one violation it
+reports. Rules never raise on violations — they return findings, so one
+`analysis check` run reports everything at once (the verify_* wrappers in
+`hlo_rules` keep the old raise-on-violation behavior for callers that want
+an acceptance gate, e.g. experiments/scaling.py).
+
+A `Contract` is one canonical training config (TrainConfig kwargs plus the
+floor below which collectives are metric noise). The matrix below is the
+set of configs whose compiled HLO must keep its promises on every PR:
+the plain data-parallel step, the zero1 sharded update, and the explicit
+bucketed reducer at each wire dtype, with and without grad accumulation.
+`hlo_rules.evaluate_contract` lowers each on the CPU test mesh and runs
+every HLO rule over the result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+# Wire modes the contracts understand. The first three are implemented
+# (parallel/grad_sync.py WIRE_DTYPES); "int8_multihop" is the DynamiQ-style
+# s8 reduce-scatter + requantize + s8 all-gather form (ROADMAP item): it
+# legitimately spends TWO collectives per bucket, so the census bound is
+# parameterized by mode instead of hard-coding 1 — implementing the mode
+# must not require relaxing the checker.
+WIRE_MODES = ("fp32", "bf16", "int8", "int8_multihop")
+
+# HLO dtype each wire mode promises on gradient-sized collective operands.
+WIRE_HLO_DTYPE = {"fp32": "f32", "bf16": "bf16", "int8": "s8",
+                  "int8_multihop": "s8"}
+
+
+def collectives_per_bucket(wire_mode: str) -> int:
+    """Gradient collectives one bucket legitimately costs under `wire_mode`.
+
+    Single-hop modes sync a bucket with ONE collective (psum, or the s8
+    gather). The multi-hop int8 form reduces in two hops (s8 all-to-all
+    reduce-scatter, requantized s8 all-gather), so its census bound is 2
+    per bucket — the contract knows the mode, the bound is never hand-
+    relaxed.
+    """
+    if wire_mode not in WIRE_MODES:
+        raise ValueError(f"unknown wire mode {wire_mode!r} "
+                         f"(choose from {WIRE_MODES})")
+    return 2 if wire_mode == "int8_multihop" else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation: where, what, and which rule said so."""
+
+    rule: str
+    message: str
+    location: str = ""  # "path:line" (AST) or a contract/config name (HLO)
+
+    def __str__(self) -> str:
+        loc = f"{self.location}: " if self.location else ""
+        return f"{loc}[{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "location": self.location,
+                "message": self.message}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One named check. `kind` is "hlo" (runs on StepArtifacts) or "ast"
+    (runs on parsed source). `rationale` is the why — it renders in
+    ``analysis check --list`` and the README catalog stays honest by
+    quoting it."""
+
+    name: str
+    kind: str
+    description: str
+    rationale: str
+    check: Callable[..., List[Finding]]
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(name: str, kind: str, description: str, rationale: str):
+    """Decorator registering a check function as a named Rule."""
+
+    def deco(fn: Callable[..., List[Finding]]):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate rule name {name!r}")
+        _REGISTRY[name] = Rule(name=name, kind=kind, description=description,
+                               rationale=rationale, check=fn)
+        return fn
+
+    return deco
+
+
+def iter_rules(kind: Optional[str] = None,
+               names: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Registered rules, optionally filtered by kind and/or names.
+
+    Unknown names raise — a typo'd ``--rules`` selection silently checking
+    nothing would be the checker failing its own contract. Importing the
+    engines here (not at module import) keeps this module dependency-free
+    for the AST-only path.
+    """
+    from . import ast_rules, hlo_rules  # noqa: F401  (registration side effect)
+
+    if names is not None:
+        wanted = list(names)
+        unknown = [n for n in wanted if n not in _REGISTRY]
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s) {unknown}; known: {sorted(_REGISTRY)}")
+        rules = [_REGISTRY[n] for n in wanted]
+    else:
+        rules = [_REGISTRY[n] for n in sorted(_REGISTRY)]
+    if kind is not None:
+        rules = [r for r in rules if r.kind == kind]
+    return rules
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """One canonical config whose lowered HLO must keep its promises.
+
+    ``config`` holds TrainConfig kwargs (zero1 / bucket_cap_mb / wire_dtype
+    / grad_accum / donate_state / overlap_grad_sync). ``min_elements`` is
+    the census floor separating gradient-sized collectives from scalar
+    metric traffic — sized to the tiny contract model, NOT the 8192 default
+    of production censuses. ``min_shards`` gates configs that only engage
+    on a multi-shard mesh (zero1 / grad_sync passthrough convention).
+    """
+
+    name: str
+    description: str
+    config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    min_elements: int = 128
+    min_shards: int = 1
+
+
+# The canonical matrix (ISSUE 3): dp, zero1, grad_sync x wire dtypes,
+# grad-accum on/off. The bucket cap is tiny (in MB) so the tiny contract
+# model still splits into >1 bucket and the ceil bound actually binds.
+_CAP = 0.02  # ~5.2k fp32 elements per bucket
+
+CONTRACT_MATRIX: Tuple[Contract, ...] = (
+    Contract("dp", "implicit data-parallel step (XLA-inserted grad sync)"),
+    Contract("dp_accum", "implicit path under gradient accumulation",
+             config=dict(grad_accum=2)),
+    Contract("zero1", "ZeRO-1 sharded weight update (scatter/update/gather)",
+             config=dict(zero1=True), min_shards=2),
+    Contract("zero1_bf16", "zero1 with the reduce-scatter half at bf16",
+             config=dict(zero1=True, wire_dtype="bf16"), min_shards=2),
+    Contract("gsync_fp32", "bucketed reducer, exact fp32 wire",
+             config=dict(bucket_cap_mb=_CAP), min_shards=2),
+    Contract("gsync_bf16", "bucketed reducer, bf16 wire",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="bf16"),
+             min_shards=2),
+    Contract("gsync_int8", "bucketed reducer, int8 wire + error feedback",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8"),
+             min_shards=2),
+    Contract("gsync_bf16_accum",
+             "bucketed bf16 reducer with in-scan overlapped accumulation",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="bf16",
+                         grad_accum=2), min_shards=2),
+)
+
+
+def get_contract(name: str) -> Contract:
+    for c in CONTRACT_MATRIX:
+        if c.name == name:
+            return c
+    raise KeyError(f"unknown contract {name!r}; "
+                   f"known: {[c.name for c in CONTRACT_MATRIX]}")

@@ -293,6 +293,48 @@ def timed_steps(step_fn: Callable, state, batch, global_batch: int,
     return sps, sps * global_batch
 
 
+def _contract_check(trainer, state, optimized_text: str, lowered,
+                    zero1: bool, grad_sync: Optional[dict]) -> Optional[dict]:
+    """Evaluate the HLO contract rules against the measured executable and
+    return {"pass": bool, "violations": [...]} for the bench row — the
+    per-arm pass/fail bench history tracks across PRs (ISSUE 3).
+    Best-effort by design: a checker failure is recorded as an error
+    string, never a measurement failure."""
+    try:
+        from ..analysis.hlo_rules import (
+            StepArtifacts, check_artifacts, preopt_hlo_text,
+            replicated_large_buffers,
+        )
+        from ..parallel.grad_sync import build_bucket_plan
+        from ..parallel.mesh import batch_shard_count
+
+        cfg = dict(grad_sync or {})
+        cfg["zero1"] = bool(zero1)
+        cfg["donate_state"] = trainer.config.donate_state
+        try:
+            preopt = preopt_hlo_text(lowered)
+        except Exception:
+            preopt = None
+        plan = build_bucket_plan(state.params,
+                                 float(cfg.get("bucket_cap_mb", 0.0)))
+        artifacts = StepArtifacts(
+            name="bench",
+            optimized_text=optimized_text,
+            preopt_text=preopt,
+            config=cfg,
+            n_shards=batch_shard_count(trainer.mesh),
+            total_grad_bytes=plan.total_bytes,
+            replicated_state_buffers=(
+                replicated_large_buffers(state.opt_state, 8192)
+                if zero1 else ()),
+        )
+        findings = check_artifacts(artifacts)
+        return {"pass": not findings,
+                "violations": [f.as_dict() for f in findings]}
+    except Exception as e:  # noqa: BLE001 - observability must not kill a run
+        return {"pass": None, "error": f"{type(e).__name__}: {e}"}
+
+
 def measure_config(model_name: str, per_device_batch: int, steps: int,
                    bf16: bool, repeats: int = 3, seq_len: int = 512,
                    image_hw: int = 32, num_classes: int = 10,
@@ -340,7 +382,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
 
         key = jax.random.PRNGKey(0)
         # AOT-compile once: cost analysis reads the exact executable we time.
-        compiled = trainer._train_step.lower(state, batch, key).compile()
+        lowered = trainer._train_step.lower(state, batch, key)
+        compiled = lowered.compile()
 
         xla_flops = flops_mod.xla_flops_per_step(compiled)
         analytic_fwd = flops_mod.jaxpr_matmul_flops(
@@ -349,7 +392,10 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
 
         from .trace_analysis import grad_sync_census
 
-        sync_census = grad_sync_census(compiled.as_text())
+        optimized_text = compiled.as_text()
+        sync_census = grad_sync_census(optimized_text)
+        contracts = _contract_check(trainer, state, optimized_text, lowered,
+                                    zero1=zero1, grad_sync=grad_sync)
 
         exposed_comm_pct = None
         if comm_trace and len(devices) > 1:
@@ -414,6 +460,10 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # the measured executable, and (comm_trace) the exposed-comm split
         "grad_collectives": sync_census["n_collectives"],
         "grad_wire_dtypes": sync_census["wire_dtypes"],
+        # per-arm parallelism-contract verdict (analysis/hlo_rules.py):
+        # bench history records whether the measured executable kept its
+        # collective/wire/donation promises, not just how fast it ran
+        "contracts": contracts,
     }
     if exposed_comm_pct is not None:
         result["exposed_comm_pct"] = exposed_comm_pct

@@ -23,13 +23,18 @@ import gzip
 import json
 import re
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 # Collective op names as they appear on XLA timelines (sync form, async
 # `-start` form, and CPU thunk form). `-done` events are completion markers
-# whose duration is wait-not-work; skip them like the HLO census does.
+# whose duration is wait-not-work; skip them like the HLO census does —
+# an async collective's `-start` span covers the transfer, so counting
+# both halves of a pair would double its time. `ragged-all-to-all` (MoE
+# dispatch at uneven expert loads) precedes `all-to-all` so the longer
+# name keys the by_op breakdown.
 _COLLECTIVE_RE = re.compile(
-    r"^(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+    r"^(all-reduce|all-gather|reduce-scatter|collective-permute"
+    r"|ragged-all-to-all|all-to-all)"
     r"(?!.*-done)")
 
 # Host-side runtime bookkeeping seen on CPU traces (no device lanes exist
@@ -129,211 +134,17 @@ def collective_share(log_dir: str) -> dict:
 
 
 # ---------------------------------------------------------------------------
-# Static HLO collective census (the compile-time half of the gradient-sync
-# analysis; the trace functions above are the runtime half).
+# Static HLO collective census — MOVED to analysis/hlo_rules.py (ISSUE 3:
+# the compile-time half of the gradient-sync analysis is now a checked
+# contract subsystem, not scattered helpers). Re-exported here so existing
+# callers (scaling.py, harness.py, tests, notebooks) keep working.
 # ---------------------------------------------------------------------------
 
-# HLO text: `%name = shape op-name(...)`. On TPU the latency-hiding scheduler
-# splits collectives into async `-start`/`-done` pairs; count the `-start`
-# half (and bare sync forms), never `-done`, so each collective counts once.
-_HLO_COLLECTIVE_RE = re.compile(
-    r"=\s*(\([^)]*\)|\S+)\s+"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
-    r"(-start|-done)?[.\w]*\(")
-
-# One array shape inside an HLO result: "f32[1000,512]{1,0}" (possibly inside
-# a tuple). Captures the bracketed dims; "f32[]" is a scalar.
-_HLO_SHAPE_RE = re.compile(r"\w+\[([\d,]*)\]")
-
-# Same shape token with the DTYPE captured instead ("f32", "bf16", "s8") —
-# the wire-dtype read of `grad_sync_census`. Context/token dtypes (u32 ids
-# in async tuples) ride along; the census reports all of them.
-_HLO_TYPED_SHAPE_RE = re.compile(r"(\w+)\[[\d,]*\]")
-
-
-def hlo_result_elements(shape_str: str) -> int:
-    """Total elements across every array in an HLO result shape string
-    (async collectives return tuples; sum the parts so `-start` forms
-    compare like their sync equivalents)."""
-    total = 0
-    for m in _HLO_SHAPE_RE.finditer(shape_str):
-        dims = m.group(1)
-        if not dims:
-            total += 1  # scalar
-            continue
-        n = 1
-        for d in dims.split(","):
-            n *= int(d)
-        total += n
-    return total
-
-
-def collective_census(compiled_text: str) -> List[dict]:
-    """Census of collective ops in optimized HLO text: op kind + result shape.
-
-    The static half of the grad-sync analysis: what the compiler actually
-    scheduled (names/shapes straight from the executable), standing in for
-    the reference's promised profiler-timeline read-off (README.md:35)."""
-    rows = {}
-    for m in _HLO_COLLECTIVE_RE.finditer(compiled_text):
-        shape, kind, suffix = m.group(1), m.group(2), m.group(3)
-        if suffix == "-done":
-            continue  # the paired completion of an async -start
-        key = (kind, shape)
-        if key not in rows:
-            rows[key] = {"op": kind, "result_shape": shape, "count": 0}
-        rows[key]["count"] += 1
-    return sorted(rows.values(), key=lambda r: (r["op"], r["result_shape"]))
-
-
-def weight_update_census(compiled_text: str, min_elements: int = 8192) -> dict:
-    """The gradient-sync subset of the census: collectives whose result
-    carries at least `min_elements` elements — gradient- and parameter-sized
-    transfers. Scalar psums (metric fan-in, global-norm clipping, BatchNorm
-    channel stats) fall under the floor, so the returned counts isolate the
-    ops that move the model: the DDP-style grad all-reduce on the replicated
-    path, reduce-scatter + all-gather on the zero1 path.
-
-    Returns {"all-reduce": n, "reduce-scatter": n, "all-gather": n,
-    "rows": [...]} (other collective kinds appear only if present)."""
-    counts: Dict[str, int] = {"all-reduce": 0, "reduce-scatter": 0,
-                              "all-gather": 0}
-    rows = []
-    for c in collective_census(compiled_text):
-        if hlo_result_elements(c["result_shape"]) < min_elements:
-            continue
-        counts[c["op"]] = counts.get(c["op"], 0) + c["count"]
-        rows.append(c)
-    counts["rows"] = rows
-    return counts
-
-
-def verify_zero1_collectives(replicated_text: str, zero1_text: str,
-                             min_elements: int = 8192) -> dict:
-    """The acceptance check for the zero1 mode (ISSUE 1): in the compiled
-    zero1 step, gradient-sized all-reduces are REPLACED by reduce-scatter +
-    all-gather. Returns the two weight-update censuses plus a verdict dict;
-    raises AssertionError naming the offending ops when the replacement did
-    not happen (a silent fallback to all-reduce would erase the win while
-    the flag still claims it)."""
-    rep = weight_update_census(replicated_text, min_elements)
-    z1 = weight_update_census(zero1_text, min_elements)
-    if rep["all-reduce"] == 0:
-        raise AssertionError(
-            "replicated step shows no gradient-sized all-reduce — the "
-            f"census floor ({min_elements} elements) is above the model's "
-            "gradient transfers; lower min_elements")
-    problems = []
-    if z1["all-reduce"]:
-        problems.append(
-            f"zero1 step still contains {z1['all-reduce']} gradient-sized "
-            f"all-reduce(s): {[r for r in z1['rows'] if r['op'] == 'all-reduce']}")
-    if not z1["reduce-scatter"]:
-        problems.append("zero1 step contains no reduce-scatter")
-    if not z1["all-gather"]:
-        problems.append("zero1 step contains no all-gather")
-    if problems:
-        raise AssertionError("; ".join(problems))
-    return {"replicated": rep, "zero1": z1}
-
-
-def grad_sync_census(hlo_text: str, min_elements: int = 8192) -> dict:
-    """Census of the gradient-sync stage in HLO text: how many gradient-
-    sized collectives the step carries, and what dtype rides the wire.
-
-    The instrument for the bucketed reducer (parallel/grad_sync.py): with
-    ``bucket_cap_mb`` set, the compiled step must show
-    ``ceil(total_grad_bytes / cap)`` large collectives (one per bucket)
-    instead of one per leaf, and with a compressed ``wire_dtype`` their
-    operands must be bf16/s8, not f32. Accepts optimized HLO
-    (``compiled.as_text()``) or pre-optimization HLO (`preopt_hlo_text`):
-    CPU's float-normalization pass promotes bf16 collectives to f32 in the
-    OPTIMIZED text, so wire-dtype checks on the test backend read the
-    pre-optimization module (TPU keeps bf16 end-to-end).
-
-    Returns {"n_collectives", "by_op": {op: n}, "wire_dtypes": {dtype: n},
-    "rows": [...]} counting only collectives whose result carries at least
-    `min_elements` elements (scalar metric psums and int8 scale gathers
-    fall under the floor).
-    """
-    by_op: Dict[str, int] = {}
-    wire: Dict[str, int] = {}
-    rows = []
-    total = 0
-    for c in collective_census(hlo_text):
-        if hlo_result_elements(c["result_shape"]) < min_elements:
-            continue
-        total += c["count"]
-        by_op[c["op"]] = by_op.get(c["op"], 0) + c["count"]
-        dtypes = sorted(set(
-            m.group(1)
-            for m in _HLO_TYPED_SHAPE_RE.finditer(c["result_shape"])))
-        for d in dtypes:
-            wire[d] = wire.get(d, 0) + c["count"]
-        rows.append({**c, "dtypes": dtypes})
-    return {"n_collectives": total, "by_op": by_op, "wire_dtypes": wire,
-            "rows": rows}
-
-
-def preopt_hlo_text(lowered) -> str:
-    """Pre-optimization HLO text of a ``jax.jit(...).lower(...)`` result —
-    the wire-dtype read for `grad_sync_census` (see its docstring: the CPU
-    backend's float-normalization rewrites bf16 collectives to f32 before
-    the optimized text is printed)."""
-    return lowered.compiler_ir(dialect="hlo").as_hlo_text()
-
-
-def verify_grad_sync_collectives(
-    optimized_text: str,
-    *,
-    total_grad_bytes: int,
-    bucket_cap_mb: float,
-    wire_dtype: str = "fp32",
-    wire_text: Optional[str] = None,
-    min_elements: int = 8192,
-    slack: int = 2,
-) -> dict:
-    """The ISSUE-2 acceptance check for the bucketed reducer: the compiled
-    step performs at most ``ceil(total_grad_bytes / bucket_cap) + slack``
-    gradient-sized collectives, and compressed modes put bf16/int8 on the
-    wire. ``wire_text`` defaults to ``optimized_text``; pass the
-    pre-optimization HLO on backends that promote small floats (CPU).
-    Raises AssertionError naming the violation; returns the censuses.
-    """
-    census = grad_sync_census(optimized_text, min_elements)
-    # The SAME arithmetic as grad_sync.build_bucket_plan (which floors the
-    # cap to whole fp32 elements): re-deriving it as ceil(bytes/cap_bytes)
-    # would under-count buckets whenever the cap is not element-aligned and
-    # flag a correctly engaged reducer.
-    total_elems = int(total_grad_bytes) // 4
-    cap_elems = int(bucket_cap_mb * (1024 ** 2) // 4)
-    if bucket_cap_mb <= 0 or cap_elems >= total_elems:
-        n_buckets = 1  # no/huge cap = one fused bucket
-    else:
-        n_buckets = -(-total_elems // max(cap_elems, 1))
-    bound = n_buckets + slack
-    if census["n_collectives"] > bound:
-        raise AssertionError(
-            f"bucketed step carries {census['n_collectives']} gradient-"
-            f"sized collectives, more than ceil({total_grad_bytes}B / "
-            f"{bucket_cap_mb}MB) + {slack} = {bound}: {census['by_op']} — "
-            "bucketing is not engaged (or the census floor "
-            f"min_elements={min_elements} is below scalar traffic)")
-    if census["n_collectives"] == 0:
-        raise AssertionError(
-            "no gradient-sized collectives found — the census floor "
-            f"(min_elements={min_elements}) is above the model's gradient "
-            "transfers; lower it")
-    wire_census = (grad_sync_census(wire_text, min_elements)
-                   if wire_text is not None else census)
-    expect = {"fp32": "f32", "bf16": "bf16", "int8": "s8"}[wire_dtype]
-    if not wire_census["wire_dtypes"].get(expect):
-        raise AssertionError(
-            f"wire_dtype={wire_dtype!r} promises {expect} collective "
-            f"operands on the wire, but the HLO shows "
-            f"{wire_census['wire_dtypes']}")
-    return {"census": census, "wire": wire_census["wire_dtypes"],
-            "bound": bound}
+from ..analysis.hlo_rules import (  # noqa: E402,F401
+    collective_census, grad_sync_census, hlo_result_elements,
+    preopt_hlo_text, verify_grad_sync_collectives, verify_zero1_collectives,
+    weight_update_census,
+)
 
 
 def comm_overlap_split(log_dir: str) -> dict:

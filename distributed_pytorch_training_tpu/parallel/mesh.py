@@ -45,6 +45,13 @@ EXPERT = "expert"
 # The order axes are laid out in the physical mesh — bandwidth-hungry last.
 AXIS_ORDER: tuple[str, ...] = (PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
 
+# The canonical axis-name registry. Code elsewhere must use the constants
+# above (or AXIS_ORDER/BATCH_AXES), never the string literals: the
+# `axis-name-registry` lint (analysis/ast_rules.py) flags literals in
+# collective/PartitionSpec positions outside this module, and its
+# import-free mirror of this set is pinned to AXIS_NAMES by a tier-1 test.
+AXIS_NAMES: frozenset = frozenset(AXIS_ORDER)
+
 # Axes a batch dimension may be sharded over (see sharding.batch_spec).
 BATCH_AXES: tuple[str, ...] = (DATA, FSDP)
 

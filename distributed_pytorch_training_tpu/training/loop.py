@@ -15,6 +15,14 @@ Improvements over the reference, by design:
   (ref :266-300 evaluates the full set on every rank; SURVEY.md §3.3);
 * the last partial batch is padded+masked, so one XLA program serves every
   step (ref's drop_last=False short batch would recompile, SURVEY.md §7).
+
+The parallelism promises the step modes make here (zero1's
+scatter/update/gather signature, the bucketed reducer's collective bound,
+compressed wires really off fp32, donation aliasing, no host transfers in
+the compiled step, no per-step ``.item()`` syncs) are ENFORCED by the
+contract checker — ``analysis check`` lowers the canonical config matrix
+and lints this file's step paths (analysis/hlo_rules.py,
+analysis/ast_rules.py ``no-host-sync-in-step``).
 """
 
 from __future__ import annotations

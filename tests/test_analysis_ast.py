@@ -1,0 +1,327 @@
+"""AST lint engine (analysis/ast_rules.py): every rule has a mutation test
+(a synthetic violation it must flag) and a false-positive test (idiomatic
+code it must NOT flag) — the analyzer is verified, not just green.
+"""
+
+import textwrap
+
+import pytest
+
+from distributed_pytorch_training_tpu.analysis.ast_rules import (
+    AXIS_NAMES, FileContext, iter_source_files, run_ast_rules,
+    traced_function_names,
+)
+
+
+def _lint(tmp_path, source, rules=None, name="mod.py"):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_ast_rules(files=[path], rules=rules)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# shard-map-shim-only
+# ---------------------------------------------------------------------------
+
+
+class TestShardMapShimOnly:
+    def test_mutation_every_import_form_flags(self, tmp_path):
+        for src in (
+            "import jax.experimental.shard_map\n",
+            "from jax.experimental.shard_map import shard_map\n",
+            "from jax.experimental import shard_map\n",
+            "from jax.experimental import mesh_utils, shard_map\n",
+            "from jax import shard_map\n",
+            "import jax\nf = jax.shard_map(lambda x: x)\n",
+            "import jax\nf = jax.experimental.shard_map.shard_map\n",
+        ):
+            findings = _lint(tmp_path, src, rules=["shard-map-shim-only"])
+            assert findings, f"did not flag: {src!r}"
+
+    def test_chained_attribute_use_reports_once(self, tmp_path):
+        """`jax.experimental.shard_map.shard_map` is ONE use, not two —
+        the inner Attribute chain must not double the finding count."""
+        src = "import jax\nf = jax.experimental.shard_map.shard_map\n"
+        findings = _lint(tmp_path, src, rules=["shard-map-shim-only"])
+        assert len(findings) == 1, findings
+
+    def test_mutation_check_rep_kwarg_outside_shim_flags(self, tmp_path):
+        src = """
+            from distributed_pytorch_training_tpu.parallel import shard_map
+            f = shard_map(lambda x: x, mesh=None, in_specs=None,
+                          out_specs=None, check_rep=False)
+        """
+        findings = _lint(tmp_path, src, rules=["shard-map-shim-only"])
+        assert _rules_of(findings) == {"shard-map-shim-only"}
+        assert "check_rep" in findings[0].message
+        # the renamed flag is the same violation
+        src_vma = src.replace("check_rep", "check_vma")
+        assert _lint(tmp_path, src_vma, rules=["shard-map-shim-only"])
+
+    def test_docstring_and_string_mentions_do_not_flag(self, tmp_path):
+        """THE false-positive class the regex lint had (ISSUE 3 satellite):
+        prose about the entry points is not a use of them."""
+        src = '''
+            """Module docs: jax.experimental.shard_map moved to
+            jax.shard_map; never `from jax.experimental import shard_map`.
+            """
+            MSG = "use jax.shard_map via the shim"
+
+            def f():
+                """Docs quoting jax.experimental.shard_map.shard_map(...)."""
+                return MSG  # comment: jax.shard_map is the new entry point
+        '''
+        assert _lint(tmp_path, src, rules=["shard-map-shim-only"]) == []
+
+    def test_shim_import_from_parallel_is_clean(self, tmp_path):
+        src = """
+            from distributed_pytorch_training_tpu.parallel import shard_map
+            g = shard_map(lambda x: x, mesh=None, in_specs=None,
+                          out_specs=None)
+        """
+        assert _lint(tmp_path, src, rules=["shard-map-shim-only"]) == []
+
+
+# ---------------------------------------------------------------------------
+# no-impure-calls-in-traced
+# ---------------------------------------------------------------------------
+
+
+class TestImpureCallsInTraced:
+    def test_mutation_time_random_nprandom_flag(self, tmp_path):
+        src = """
+            import time, random
+            import numpy as np
+            import jax
+
+            def step(x):
+                t = time.perf_counter()
+                r = random.random()
+                z = np.random.rand(3)
+                return x + t + r + z.sum()
+
+            f = jax.jit(step)
+        """
+        findings = _lint(tmp_path, src,
+                         rules=["no-impure-calls-in-traced"])
+        msgs = "\n".join(f.message for f in findings)
+        assert len(findings) == 3, msgs
+        assert "time.perf_counter" in msgs
+        assert "random.random" in msgs
+        assert "numpy.random.rand" in msgs
+
+    def test_mutation_nested_and_decorated_and_from_imports(self, tmp_path):
+        src = """
+            import jax
+            from functools import partial
+            from time import time as now
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(x):
+                def inner(y):
+                    return y * now()
+                return inner(x)
+        """
+        findings = _lint(tmp_path, src,
+                         rules=["no-impure-calls-in-traced"])
+        assert len(findings) == 1 and "time.time" in findings[0].message
+
+    def test_shard_map_body_by_name_is_traced(self, tmp_path):
+        src = """
+            import numpy as np
+            from distributed_pytorch_training_tpu.parallel import shard_map
+
+            def body(x):
+                return x * np.random.rand()
+
+            f = shard_map(body, mesh=None, in_specs=None, out_specs=None)
+        """
+        findings = _lint(tmp_path, src,
+                         rules=["no-impure-calls-in-traced"])
+        assert len(findings) == 1
+
+    def test_pure_numpy_shape_math_and_untraced_calls_clean(self, tmp_path):
+        src = """
+            import time
+            import numpy as np
+            import jax
+
+            def step(x):
+                n = np.prod(np.shape(x)) or 1   # trace-time shape math: OK
+                k = jax.random.fold_in(jax.random.PRNGKey(0), 1)  # pure
+                return x.reshape(n) + jax.random.normal(k, (n,))
+
+            f = jax.jit(step)
+
+            def host_loop():
+                return time.time()  # not traced: OK
+        """
+        assert _lint(tmp_path, src,
+                     rules=["no-impure-calls-in-traced"]) == []
+
+
+# ---------------------------------------------------------------------------
+# no-host-sync-in-step
+# ---------------------------------------------------------------------------
+
+
+class TestHostSyncInStep:
+    def test_mutation_item_float_device_get_flag(self, tmp_path):
+        src = """
+            import jax
+
+            class Trainer:
+                def _train_step_impl(self, state, batch):
+                    loss = compute(state, batch)
+                    host = loss.item()
+                    also = float(loss)
+                    got = jax.device_get(loss)
+                    return host + also + got
+        """
+        findings = _lint(tmp_path, src, rules=["no-host-sync-in-step"],
+                         name="training/loop.py")
+        assert len(findings) == 3
+        msgs = "\n".join(f.message for f in findings)
+        assert ".item()" in msgs and "float()" in msgs \
+            and "jax.device_get" in msgs
+
+    def test_scoped_to_loop_py_and_step_paths_only(self, tmp_path):
+        src_other = """
+            def _train_step_impl(self, state):
+                return float(state)
+        """
+        # same violation in another file: out of scope
+        assert _lint(tmp_path, src_other, rules=["no-host-sync-in-step"],
+                     name="training/other.py") == []
+        # loop.py, but a print-boundary fetch OUTSIDE the step path: allowed
+        src_epoch = """
+            def train_epoch(self, state, batches):
+                for b in batches:
+                    state, metrics = self._train_step(state, b)
+                return float(metrics)
+        """
+        assert _lint(tmp_path, src_epoch, rules=["no-host-sync-in-step"],
+                     name="training/loop.py") == []
+        # float(literal) in a step path is not a device sync
+        src_lit = """
+            def _eval_step_impl(self, state):
+                return state * float(2)
+        """
+        assert _lint(tmp_path, src_lit, rules=["no-host-sync-in-step"],
+                     name="training/loop.py") == []
+
+
+# ---------------------------------------------------------------------------
+# axis-name-registry
+# ---------------------------------------------------------------------------
+
+
+class TestAxisNameRegistry:
+    def test_registry_matches_mesh_module(self):
+        """The lint registry is import-free by design; it must stay the
+        mirror of the real one (parallel/mesh.py AXIS_NAMES)."""
+        from distributed_pytorch_training_tpu.parallel import mesh
+
+        assert AXIS_NAMES == mesh.AXIS_NAMES == frozenset(mesh.AXIS_ORDER)
+
+    def test_mutation_literals_in_axis_positions_flag(self, tmp_path):
+        src = """
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from distributed_pytorch_training_tpu.parallel.collectives import (
+                all_gather, psum,
+            )
+
+            def body(x):
+                a = lax.psum(x, "data")
+                b = psum(x, ("data", "fsdp"))
+                c = all_gather(x, axis_name="model")
+                return a + b + c
+
+            SPEC = P("data", None)
+        """
+        findings = _lint(tmp_path, src, rules=["axis-name-registry"])
+        flagged = sorted(f.message.split("'")[1] for f in findings)
+        assert flagged == ["data", "data", "data", "fsdp", "model"], findings
+
+    def test_non_axis_positions_do_not_flag(self, tmp_path):
+        src = """
+            cfg = {"model": "resnet18", "seq": 16}
+
+            def report(cfg):
+                return cfg.get("model"), cfg["seq"], "data"
+
+            def loss(x):
+                return x.sum("data")  # not a collective call
+        """
+        assert _lint(tmp_path, src, rules=["axis-name-registry"]) == []
+
+
+# ---------------------------------------------------------------------------
+# engine mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_suppression_comment_skips_finding(self, tmp_path):
+        src = """
+            from jax import lax
+
+            def body(x):
+                a = lax.psum(x, "data")  # analysis: disable=axis-name-registry
+                b = lax.pmean(x, "data")  # analysis: disable=all
+                c = lax.pmax(x, "data")
+                return a + b + c
+        """
+        findings = _lint(tmp_path, src, rules=["axis-name-registry"])
+        assert len(findings) == 1
+        assert findings[0].location.endswith(":7")
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        findings = _lint(tmp_path, "def broken(:\n")
+        assert _rules_of(findings) == {"parse-error"}
+
+    def test_unknown_rule_name_raises(self, tmp_path):
+        with pytest.raises(KeyError, match="no-such-rule"):
+            _lint(tmp_path, "x = 1\n", rules=["no-such-rule"])
+
+    def test_traced_name_discovery(self, tmp_path):
+        path = tmp_path / "t.py"
+        path.write_text(textwrap.dedent("""
+            import jax
+            from distributed_pytorch_training_tpu.parallel import shard_map
+
+            class T:
+                def __init__(self):
+                    self._step = jax.jit(self._step_impl, donate_argnums=(0,))
+
+                def _step_impl(self, s):
+                    return s
+
+            g = shard_map(lambda x: x, mesh=None, in_specs=None,
+                          out_specs=None)
+
+            @jax.jit
+            def decorated(x):
+                return x
+        """))
+        names = traced_function_names(FileContext.parse(path))
+        assert {"_step_impl", "decorated"} <= names
+
+    def test_source_file_set_covers_package_and_scripts_not_tests(self):
+        files = {p.name for p in iter_source_files()}
+        assert "loop.py" in files and "bench.py" in files \
+            and "train.py" in files
+        assert "test_analysis_ast.py" not in files
+
+
+def test_repo_is_clean_under_every_ast_rule():
+    """The tier-1 gate for the source-level contracts: the package and the
+    top-level scripts carry zero violations (suppressions included)."""
+    findings = run_ast_rules()
+    assert not findings, "\n".join(str(f) for f in findings)

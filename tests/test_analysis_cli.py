@@ -1,0 +1,84 @@
+"""`analysis check` CLI (analysis/__main__.py): the tier-1 gate — the full
+rule suite over the repo source AND the canonical config matrix lowered on
+the CPU test mesh must exit 0 (ISSUE 3 acceptance).
+"""
+
+import json
+
+from distributed_pytorch_training_tpu.analysis.__main__ import main
+
+
+def test_analysis_check_json_exits_0_on_repo(capsys, devices):
+    """THE acceptance test: every AST rule over the repo plus every HLO
+    contract in the matrix (dp / zero1 / grad_sync x wires / accum),
+    lowered on the 8-device CPU mesh — clean, and every contract really
+    evaluated (a matrix of skips would be vacuously green)."""
+    assert main(["check", "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True and report["findings"] == []
+    statuses = report["contracts"]
+    assert set(statuses) == {"dp", "dp_accum", "zero1", "zero1_bf16",
+                             "gsync_fp32", "gsync_bf16", "gsync_int8",
+                             "gsync_bf16_accum"}
+    assert all(s == "pass" for s in statuses.values()), statuses
+    # both engines actually ran
+    kinds = {r for r in report["rules_run"]}
+    assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
+
+
+def test_ast_only_is_fast_and_clean(capsys):
+    assert main(["check", "--ast-only"]) == 0
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+    assert "contract" not in out  # no HLO matrix ran
+
+
+def test_rules_selection_and_unknown_rule(capsys):
+    assert main(["check", "--ast-only", "--rules",
+                 "shard-map-shim-only,axis-name-registry"]) == 0
+    assert main(["check", "--rules", "no-such-rule"]) == 2
+    assert "no-such-rule" in capsys.readouterr().err
+
+
+def test_unknown_contract_is_a_usage_error(capsys):
+    assert main(["check", "--contracts", "warp-drive"]) == 2
+    assert "warp-drive" in capsys.readouterr().err
+
+
+def test_list_prints_catalog_with_rationales(capsys):
+    assert main(["check", "--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("shard-map-shim-only", "no-impure-calls-in-traced",
+                 "no-host-sync-in-step", "axis-name-registry",
+                 "grad-sync-bucket-bound", "compressed-wire",
+                 "no-fp32-wire", "zero1-collectives", "zero1-sharded-state",
+                 "donated-buffers-elided", "no-host-transfer",
+                 "dp-sync-present"):
+        assert name in out, name
+    assert "why:" in out
+
+
+def test_console_script_is_declared():
+    """The pyproject entry point must keep pointing at main (ISSUE 3
+    satellite: `analysis` console script)."""
+    from pathlib import Path
+
+    pyproject = (Path(__file__).resolve().parent.parent
+                 / "pyproject.toml").read_text()
+    assert ('analysis = "distributed_pytorch_training_tpu.analysis.'
+            '__main__:main"') in pyproject
+
+
+def test_findings_drive_nonzero_exit(tmp_path, capsys, monkeypatch):
+    """A violation anywhere in the linted set must flip the exit code —
+    the CLI's one job."""
+    from distributed_pytorch_training_tpu.analysis import ast_rules
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax.experimental import shard_map\n")
+    monkeypatch.setattr(ast_rules, "iter_source_files",
+                        lambda repo=None: [bad])
+    assert main(["check", "--ast-only", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is False
+    assert report["findings"][0]["rule"] == "shard-map-shim-only"

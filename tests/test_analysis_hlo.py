@@ -1,0 +1,325 @@
+"""HLO contract checker (analysis/hlo_rules.py): synthetic-HLO fixture
+tests for the census parsers (no compilation needed — parser regressions
+caught on hand-built text) and a mutation test per rule (a synthetic
+violation each rule must flag).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.analysis.contracts import (
+    WIRE_MODES, collectives_per_bucket,
+)
+from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+    StepArtifacts, check_artifacts, collective_census, expected_buckets,
+    grad_sync_census, hlo_result_elements, verify_grad_sync_collectives,
+    weight_update_census,
+)
+
+# --- hand-built HLO text fixtures ------------------------------------------
+
+HEADER = ("HloModule jit_step, is_scheduled=true, "
+          "input_output_alias={ {0}: (0, {}, may-alias) }, "
+          "entry_computation_layout={(f32[64]{0})->f32[64]{0}}")
+HEADER_NO_ALIAS = ("HloModule jit_step, is_scheduled=true, "
+                   "entry_computation_layout={(f32[64]{0})->f32[64]{0}}")
+
+
+def big_allreduce(i=0, n=16384, dt="f32"):
+    return (f"  %all-reduce.{i} = {dt}[{n}]{{0}} "
+            f"all-reduce({dt}[{n}]{{0}} %x.{i}), replica_groups={{}}")
+
+
+def _module(body_lines, header=HEADER):
+    return header + "\n\nENTRY %main {\n" + "\n".join(body_lines) + "\n}\n"
+
+
+SYNTH = _module([
+    "  %p = f32[64]{0} parameter(0)",
+    big_allreduce(1),                              # 16384 elements, counted
+    "  %ar2 = f32[10]{0} all-reduce(f32[10]{0} %p)",     # under the floor
+    "  %rs = bf16[8192]{0} reduce-scatter(bf16[8192]{0} %p), dimensions={0}",
+    "  %ag = f32[65536]{0} all-gather(f32[8192]{0} %p), dimensions={0}",
+    # async pair: -start counts once, -done never
+    "  %ars = (f32[16384]{0}, u32[]) all-reduce-start(f32[16384]{0} %p)",
+    "  %ard = f32[16384]{0} all-reduce-done((f32[16384]{0}, u32[]) %ars)",
+    # MoE dispatch op (the widened alternation)
+    "  %ra = s8[32768]{0} ragged-all-to-all(s8[32768]{0} %p, s32[8]{0} %s)",
+    "  %scal = f32[] all-reduce(f32[] %w)",              # scalar metric psum
+])
+
+
+class TestParsers:
+    def test_hlo_result_elements(self):
+        assert hlo_result_elements("f32[100,5]{1,0}") == 500
+        assert hlo_result_elements("f32[]") == 1
+        assert hlo_result_elements("(f32[8]{0}, u32[])") == 9
+        assert hlo_result_elements("(bf16[4,4]{1,0}, f32[2]{0})") == 18
+
+    def test_collective_census_counts_each_async_pair_once(self):
+        census = {(c["op"], c["result_shape"]): c["count"]
+                  for c in collective_census(SYNTH)}
+        assert census[("all-reduce", "f32[16384]{0}")] == 1
+        assert census[("all-reduce", "(f32[16384]{0}, u32[])")] == 1
+        assert ("all-reduce", "f32[16384]{0}") in census  # -done skipped:
+        assert sum(n for (op, _), n in census.items()
+                   if op == "all-reduce") == 4  # 16384, 10, start-pair, scalar
+
+    def test_collective_census_finds_ragged_all_to_all(self):
+        ops = {c["op"] for c in collective_census(SYNTH)}
+        assert "ragged-all-to-all" in ops
+        assert "all-to-all" not in ops  # not double-keyed under the substring
+
+    def test_weight_update_census_floor_and_counts(self):
+        c = weight_update_census(SYNTH, min_elements=8192)
+        assert c["all-reduce"] == 2       # big sync + async start, no scalar
+        assert c["reduce-scatter"] == 1
+        assert c["all-gather"] == 1
+        assert c["ragged-all-to-all"] == 1
+        assert all(hlo_result_elements(r["result_shape"]) >= 8192
+                   for r in c["rows"])
+
+    def test_grad_sync_census_wire_dtypes(self):
+        c = grad_sync_census(SYNTH, min_elements=8192)
+        assert c["n_collectives"] == 5
+        assert c["wire_dtypes"]["bf16"] == 1
+        assert c["wire_dtypes"]["s8"] == 1
+        assert c["wire_dtypes"]["f32"] == 3
+        assert c["by_op"]["all-reduce"] == 2
+
+    def test_expected_buckets_matches_build_bucket_plan(self):
+        """The checker's ceil bound must reproduce build_bucket_plan's
+        floor-to-elements arithmetic exactly, odd caps included."""
+        from distributed_pytorch_training_tpu.parallel.grad_sync import (
+            build_bucket_plan,
+        )
+
+        params = {"a": np.zeros(5000), "b": np.zeros((300, 7))}
+        for cap in (0.0, 0.0007, 0.0031, 0.01, 0.02, 100.0):
+            plan = build_bucket_plan(params, cap)
+            assert expected_buckets(plan.total_bytes, cap) == plan.n_buckets, cap
+
+
+# --- per-rule mutation tests ------------------------------------------------
+
+
+def _artifacts(body_lines, header=HEADER, preopt=None, **kw):
+    kw.setdefault("n_shards", 8)
+    kw.setdefault("min_elements", 8192)
+    return StepArtifacts(name="synthetic",
+                         optimized_text=_module(body_lines, header),
+                         preopt_text=_module(preopt) if preopt else None,
+                         **kw)
+
+
+def _run(artifacts, rule):
+    return check_artifacts(artifacts, rules=[rule])
+
+
+class TestBucketBoundRule:
+    CFG = dict(bucket_cap_mb=0.125)  # 32768 fp32 elements per bucket
+
+    def test_mutation_unbucketed_step_flags(self):
+        # 2 buckets promised, 10 collectives delivered
+        a = _artifacts([big_allreduce(i) for i in range(10)],
+                       config=self.CFG, total_grad_bytes=2 * 131072)
+        assert _run(a, "grad-sync-bucket-bound")
+
+    def test_mutation_empty_census_flags(self):
+        a = _artifacts(["  %p = f32[64]{0} parameter(0)"],
+                       config=self.CFG, total_grad_bytes=2 * 131072)
+        assert _run(a, "grad-sync-bucket-bound")
+
+    def test_engaged_step_within_bound_is_clean(self):
+        a = _artifacts([big_allreduce(i) for i in range(2)],
+                       config=self.CFG, total_grad_bytes=2 * 131072)
+        assert _run(a, "grad-sync-bucket-bound") == []
+
+    def test_not_engaged_skips(self):
+        a = _artifacts([big_allreduce(i) for i in range(10)],
+                       config={}, total_grad_bytes=2 * 131072)
+        assert _run(a, "grad-sync-bucket-bound") == []
+
+
+class TestWireRules:
+    CFG = dict(bucket_cap_mb=1.0, wire_dtype="bf16")
+
+    def test_mutation_fp32_only_wire_flags_compressed_wire(self):
+        a = _artifacts([big_allreduce()], preopt=[big_allreduce()],
+                       config=self.CFG, total_grad_bytes=65536)
+        assert _run(a, "compressed-wire")
+
+    def test_mutation_fp32_alongside_bf16_flags_no_fp32_wire(self):
+        pre = [big_allreduce(1, dt="bf16"), big_allreduce(2, dt="f32")]
+        a = _artifacts([big_allreduce()], preopt=pre,
+                       config=self.CFG, total_grad_bytes=65536)
+        assert _run(a, "compressed-wire") == []   # bf16 is present...
+        assert _run(a, "no-fp32-wire")            # ...but f32 rides along
+
+    def test_wire_rules_abstain_without_preopt_text(self):
+        """No pre-opt text = no reliable wire read (CPU promotes bf16 to
+        f32 in the optimized module): the wire rules must abstain, not
+        convert an extraction failure into a false violation."""
+        a = _artifacts([big_allreduce()], preopt=None,
+                       config=self.CFG, total_grad_bytes=65536)
+        assert _run(a, "compressed-wire") == []
+        assert _run(a, "no-fp32-wire") == []
+
+    def test_bf16_wire_is_clean_and_param_gather_exempt(self):
+        pre = [big_allreduce(1, dt="bf16"),
+               # the zero1 param all-gather stays exact by design
+               "  %ag = f32[65536]{0} all-gather(f32[8192]{0} %p)"]
+        a = _artifacts([big_allreduce()], preopt=pre,
+                       config=dict(zero1=True, wire_dtype="bf16"),
+                       total_grad_bytes=65536)
+        assert _run(a, "no-fp32-wire") == []
+        assert _run(a, "compressed-wire") == []
+
+
+class TestZero1Rules:
+    CFG = dict(zero1=True)
+    RS = "  %rs = f32[8192]{0} reduce-scatter(f32[65536]{0} %g)"
+    AG = "  %ag = f32[65536]{0} all-gather(f32[8192]{0} %p)"
+
+    def test_mutation_surviving_all_reduce_flags(self):
+        a = _artifacts([big_allreduce(), self.RS, self.AG], config=self.CFG)
+        assert _run(a, "zero1-collectives")
+
+    def test_mutation_missing_gather_or_scatter_flags(self):
+        assert _run(_artifacts([self.RS], config=self.CFG),
+                    "zero1-collectives")
+        assert _run(_artifacts([self.AG], config=self.CFG),
+                    "zero1-collectives")
+
+    def test_scatter_gather_signature_is_clean_incl_int8_all_to_all(self):
+        a = _artifacts([self.RS, self.AG], config=self.CFG)
+        assert _run(a, "zero1-collectives") == []
+        a2a = "  %c = s8[65536]{0} all-to-all(s8[65536]{0} %q)"
+        a = _artifacts([a2a, self.AG],
+                       config=dict(zero1=True, wire_dtype="int8"))
+        assert _run(a, "zero1-collectives") == []
+
+    def test_mutation_replicated_moment_buffer_flags(self):
+        a = _artifacts([self.RS, self.AG], config=self.CFG,
+                       replicated_state_buffers=(("['m'].mu", 65536),))
+        found = _run(a, "zero1-sharded-state")
+        assert found and "mu" in found[0].message
+        assert _run(_artifacts([self.RS, self.AG], config=self.CFG),
+                    "zero1-sharded-state") == []
+
+    def test_zero1_evaluation_reads_real_shardings(self, mesh8):
+        """Integration: the evaluator's sharding read on a real zero1 state
+        finds nothing replicated, and on a replicated (dp) state it finds
+        every moment buffer — the rule's input is live data, not a stub."""
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            replicated_large_buffers,
+        )
+        from distributed_pytorch_training_tpu.analysis.contracts import (
+            get_contract,
+        )
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            _tiny_lm_setup,
+        )
+
+        _, state_dp, _ = _tiny_lm_setup(mesh8, {})
+        assert replicated_large_buffers(state_dp.opt_state, 128)
+        _, state_z1, _ = _tiny_lm_setup(mesh8, get_contract("zero1").config)
+        assert replicated_large_buffers(state_z1.opt_state, 128) == ()
+
+
+class TestDonationRule:
+    CFG = dict(donate_state=True)
+
+    def test_mutation_missing_alias_table_flags(self):
+        a = _artifacts([big_allreduce()], header=HEADER_NO_ALIAS,
+                       config=self.CFG)
+        assert _run(a, "donated-buffers-elided")
+
+    def test_alias_table_is_clean_and_no_donate_skips(self):
+        assert _run(_artifacts([big_allreduce()], config=self.CFG),
+                    "donated-buffers-elided") == []
+        a = _artifacts([big_allreduce()], header=HEADER_NO_ALIAS,
+                       config=dict(donate_state=False))
+        assert _run(a, "donated-buffers-elided") == []
+
+
+class TestHostTransferRule:
+    def test_mutation_each_marker_flags(self):
+        markers = [
+            "  %s = f32[8]{0} send(f32[8]{0} %p, token[] %t), "
+            "is_host_transfer=true",
+            "  %o = token[] outfeed(f32[8]{0} %p, token[] %t)",
+            '  %cc = () custom-call(f32[] %m), '
+            'custom_call_target="xla_python_cpu_callback"',
+        ]
+        for line in markers:
+            a = _artifacts([line])
+            assert _run(a, "no-host-transfer"), line
+        assert _run(_artifacts([big_allreduce()]), "no-host-transfer") == []
+
+    def test_fires_on_real_debug_print_hlo(self):
+        """Mutation on REAL compiler output: a step with jax.debug.print
+        carries a host callback the rule must see."""
+        import jax
+        import jax.numpy as jnp
+
+        def leaky(x):
+            jax.debug.print("loss={l}", l=x.sum())
+            return x * 2
+
+        text = jax.jit(leaky).lower(jnp.ones(16)).compile().as_text()
+        a = StepArtifacts(name="leaky", optimized_text=text)
+        assert _run(a, "no-host-transfer")
+
+        clean = jax.jit(lambda x: x * 2).lower(jnp.ones(16)) \
+            .compile().as_text()
+        assert _run(StepArtifacts(name="ok", optimized_text=clean),
+                    "no-host-transfer") == []
+
+
+class TestDpSyncPresentRule:
+    def test_mutation_vanished_grad_sync_flags(self):
+        a = _artifacts(["  %p = f32[64]{0} parameter(0)"], config={})
+        assert _run(a, "dp-sync-present")
+
+    def test_plain_dp_with_all_reduce_is_clean_and_modes_skip(self):
+        assert _run(_artifacts([big_allreduce()], config={}),
+                    "dp-sync-present") == []
+        # engaged modes and accum are exempt (their own rules apply)
+        assert _run(_artifacts([], config=dict(zero1=True)),
+                    "dp-sync-present") == []
+        assert _run(_artifacts([], config=dict(grad_accum=2)),
+                    "dp-sync-present") == []
+
+
+# --- wire-mode parameterization (ISSUE 3 satellite: DynamiQ unblocked) -----
+
+
+class TestMultihopBound:
+    def test_collectives_per_bucket_by_mode(self):
+        assert [collectives_per_bucket(m) for m in WIRE_MODES] == [1, 1, 1, 2]
+        with pytest.raises(ValueError, match="unknown wire mode"):
+            collectives_per_bucket("int4")
+
+    def test_multihop_int8_gets_two_collectives_per_bucket(self):
+        """A DynamiQ-style implementation (s8 reduce-scatter + requantized
+        s8 gather = 2 collectives/bucket) must pass under its own mode and
+        fail under the single-hop bound — the contract is parameterized by
+        wire mode, not hand-relaxed."""
+        n_buckets, cap = 4, 0.125  # 32768-element buckets
+        total_bytes = n_buckets * 131072
+        lines = []
+        for i in range(n_buckets):
+            lines.append(f"  %rs.{i} = s8[4096]{{0}} "
+                         f"all-to-all(s8[32768]{{0}} %g.{i})")
+            lines.append(f"  %ag.{i} = s8[32768]{{0}} "
+                         f"all-gather(s8[4096]{{0}} %r.{i})")
+        text = _module(lines)
+        verdict = verify_grad_sync_collectives(
+            text, total_grad_bytes=total_bytes, bucket_cap_mb=cap,
+            wire_dtype="int8_multihop", min_elements=1024)
+        assert verdict["bound"] == 2 * n_buckets + 2
+        with pytest.raises(AssertionError, match="bucketing is not engaged"):
+            verify_grad_sync_collectives(
+                text, total_grad_bytes=total_bytes, bucket_cap_mb=cap,
+                wire_dtype="int8", min_elements=1024)

@@ -167,6 +167,46 @@ def test_comm_overlap_split_math(tmp_path):
     assert split["exposed_frac_pct"] == round(100.0 * 50 / 120, 2)
 
 
+def test_trace_census_ragged_all_to_all_and_async_pairing(tmp_path):
+    """The widened trace regex (ISSUE 3 satellite): `ragged-all-to-all`
+    (MoE dispatch) counts as communication, and an async `-start`/`-done`
+    pair counts ONCE — the `-done` completion marker's duration is
+    wait-not-work, so adding it would double the collective share."""
+    import gzip
+    import json
+
+    from distributed_pytorch_training_tpu.experiments.trace_analysis import (
+        collective_share,
+    )
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 0,
+         "dur": 100},
+        # async pair: the -start span covers the transfer (40us of work);
+        # the -done marker is a 500us wait that must NOT count
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce-start.3",
+         "ts": 100, "dur": 40},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "all-reduce-done.3",
+         "ts": 140, "dur": 500},
+        # MoE dispatch op the old alternation missed entirely
+        {"ph": "X", "pid": 1, "tid": 1, "name": "ragged-all-to-all.7",
+         "ts": 700, "dur": 25},
+    ]
+    d = tmp_path / "plugins"
+    d.mkdir()
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    share = collective_share(str(tmp_path))
+    assert share["by_op"] == {"all-reduce": 40.0, "ragged-all-to-all": 25.0}
+    assert share["collective_us"] == 65.0  # -done's 500us excluded
+    assert share["op_us"] == 665.0
+
+
 @pytest.mark.slow
 def test_experiment_pipeline_smoke(capsys):
     _run_experiment(["pipeline"] + _SMOKE)
