@@ -109,6 +109,17 @@ EXTRA_CONFIGS = (
     ("gpt2_124m_gsync_bf16", "gpt2_124m", 400,
      dict(per_device_batch=8, seq_len=1024, steps=10,
           grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="bf16"))),
+    # DynamiQ-style multi-hop int8 wire (wire_dtype="int8_multihop"):
+    # s8 all-to-all reduce-scatter + requantized s8 all-gather — exactly
+    # 2 collectives/bucket and ~2 wire B/element at ANY DP degree (the
+    # n-independent fix for the gather-form int8's (n-1)·S scaling);
+    # rows carry wire_bytes_per_replica so the claim is a recorded number
+    ("resnet18_gsync_mh", "resnet18", 420,
+     dict(per_device_batch=4096, image_hw=32, num_classes=10, steps=20,
+          grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_multihop"))),
+    ("gpt2_124m_gsync_mh", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10,
+          grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_multihop"))),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can

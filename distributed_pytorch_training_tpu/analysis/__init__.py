@@ -7,7 +7,8 @@ torch's reducer; the TPU port makes every parallelism decision explicit
 
 * **HLO contract checker** (`hlo_rules`, `contracts`): declarative
   `Contract` objects lowered on the canonical config matrix (dp, zero1,
-  grad_sync x {fp32, bf16, int8}, grad-accum on/off) and evaluated by
+  grad_sync x {fp32, bf16, int8, int8_multihop}, grad-accum on/off) and
+  evaluated by
   rules over the optimized / pre-optimization HLO text — collective
   counts, wire dtypes, donation aliasing, host transfers, sharded
   optimizer state.

@@ -21,12 +21,12 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-# Wire modes the contracts understand. The first three are implemented
-# (parallel/grad_sync.py WIRE_DTYPES); "int8_multihop" is the DynamiQ-style
-# s8 reduce-scatter + requantize + s8 all-gather form (ROADMAP item): it
-# legitimately spends TWO collectives per bucket, so the census bound is
-# parameterized by mode instead of hard-coding 1 — implementing the mode
-# must not require relaxing the checker.
+# Wire modes the contracts understand — all four are implemented
+# (parallel/grad_sync.py WIRE_DTYPES). "int8_multihop" is the DynamiQ-style
+# s8 reduce-scatter + requantize + s8 all-gather form: it legitimately
+# spends TWO collectives per bucket, so the census bound is parameterized
+# by mode instead of hard-coding 1 — the mode landed with no checker
+# relaxation, exactly as this comment promised when it was a ROADMAP item.
 WIRE_MODES = ("fp32", "bf16", "int8", "int8_multihop")
 
 # HLO dtype each wire mode promises on gradient-sized collective operands.
@@ -164,6 +164,15 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
     Contract("gsync_bf16_accum",
              "bucketed bf16 reducer with in-scan overlapped accumulation",
              config=dict(bucket_cap_mb=_CAP, wire_dtype="bf16",
+                         grad_accum=2), min_shards=2),
+    Contract("gsync_int8_mh",
+             "bucketed reducer, DynamiQ multi-hop int8 wire (s8 "
+             "reduce-scatter + requantized s8 all-gather, 2/bucket)",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop"),
+             min_shards=2),
+    Contract("gsync_int8_mh_accum",
+             "multi-hop int8 reducer with in-scan overlapped accumulation",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop",
                          grad_accum=2), min_shards=2),
 )
 

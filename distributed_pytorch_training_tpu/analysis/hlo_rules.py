@@ -225,6 +225,28 @@ _REDUCTION_KINDS = ("all-reduce", "reduce-scatter", "all-to-all",
                     "ragged-all-to-all")
 
 
+def _multihop_hop_problems(census: dict) -> List[str]:
+    """Problems with a census that CLAIMS the multi-hop int8 wire.
+
+    The 2/bucket budget is an upper bound, so a single-collective-per-
+    bucket impostor (e.g. the gather-form codec mislabeled as multihop)
+    sails under it — the hop SIGNATURE is what catches it: hop 1 must
+    appear as a scatter-kind collective (all-to-all or reduce-scatter) and
+    hop 2 as an all-gather, both gradient-sized.
+    """
+    by_op = census["by_op"]
+    problems = []
+    if not (by_op.get("all-to-all", 0) + by_op.get("reduce-scatter", 0)):
+        problems.append(
+            "multihop wire shows no gradient-sized all-to-all/reduce-"
+            "scatter — hop 1 (the s8 reduce-scatter) is missing")
+    if not by_op.get("all-gather", 0):
+        problems.append(
+            "multihop wire shows no gradient-sized all-gather — hop 2 "
+            "(the requantized s8 gather) is missing")
+    return problems
+
+
 @rule("grad-sync-bucket-bound", "hlo",
       "bucketed reducer emits <= buckets x per-bucket-cost + slack "
       "gradient-sized collectives",
@@ -252,6 +274,9 @@ def check_bucket_bound(a: StepArtifacts, slack: int = 2) -> List[Finding]:
             f"no gradient-sized collectives found — the census floor "
             f"(min_elements={a.min_elements}) is above the model's gradient "
             "transfers, or the reducer never ran", a.name))
+    elif a.wire_mode == "int8_multihop":
+        out.extend(Finding("grad-sync-bucket-bound", p, a.name)
+                   for p in _multihop_hop_problems(census))
     return out
 
 
@@ -628,6 +653,13 @@ def verify_grad_sync_collectives(
             "no gradient-sized collectives found — the census floor "
             f"(min_elements={min_elements}) is above the model's gradient "
             "transfers; lower it")
+    if wire_dtype == "int8_multihop":
+        problems = _multihop_hop_problems(census)
+        if problems:
+            raise AssertionError(
+                "; ".join(problems) + f" — census: {census['by_op']} (a "
+                "single-hop codec mislabeled as multihop sails under the "
+                "2/bucket budget; the hop signature is the check)")
     wire_census = (grad_sync_census(wire_text, min_elements)
                    if wire_text is not None else census)
     expect = WIRE_HLO_DTYPE[wire_dtype]

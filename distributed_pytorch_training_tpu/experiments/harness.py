@@ -390,12 +390,23 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
             lambda s, b: trainer.task.loss_and_metrics(
                 s, s.params, b, key, train=True)[0], state, batch)
 
+        from ..parallel.grad_sync import wire_bytes_for_config
+        from ..parallel.mesh import batch_shard_count
         from .trace_analysis import grad_sync_census
 
         optimized_text = compiled.as_text()
         sync_census = grad_sync_census(optimized_text)
         contracts = _contract_check(trainer, state, optimized_text, lowered,
                                     zero1=zero1, grad_sync=grad_sync)
+        # per-replica wire accounting of the configured sync mode (the
+        # gather-int8 break-even and the multihop flat ~2 B/element as
+        # recorded bench numbers). The helper's conventions are the
+        # bucketed/replicated reducer's; zero1's split wire (compressed
+        # scatter + exact param gather) is out of its scope — omitted.
+        wire_bytes = None
+        if not zero1:
+            wire_bytes = wire_bytes_for_config(
+                state.params, grad_sync, batch_shard_count(trainer.mesh))
 
         exposed_comm_pct = None
         if comm_trace and len(devices) > 1:
@@ -460,6 +471,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # the measured executable, and (comm_trace) the exposed-comm split
         "grad_collectives": sync_census["n_collectives"],
         "grad_wire_dtypes": sync_census["wire_dtypes"],
+        **({"wire_bytes_per_replica": wire_bytes}
+           if wire_bytes is not None else {}),
         # per-arm parallelism-contract verdict (analysis/hlo_rules.py):
         # bench history records whether the measured executable kept its
         # collective/wire/donation promises, not just how fast it ran

@@ -282,15 +282,20 @@ def run_zero1(args) -> List[dict]:
 
 def run_grad_sync(args) -> List[dict]:
     """The explicit reducer (parallel/grad_sync.py) vs the implicit XLA
-    path on the same devices: bucketed fp32, bf16 and int8+EF wire, each
-    row carrying (a) throughput, (b) the static `grad_sync_census` of the
-    compiled step — gradient-sized collective count and wire dtypes, the
-    proof the mode is engaged — and (c) the trace-derived exposed-comm
-    fraction (`comm_overlap_split`), the overlap-efficiency number DDP
-    users read off nsys timelines. `--bucket-cap-mb` sets the cap
-    (default 25, DDP's default); `--grad-accum` > 1 exercises the
-    in-scan overlap (plus a no-overlap arm isolating its win).
+    path on the same devices: bucketed fp32, bf16, int8+EF and multi-hop
+    int8 wire, each row carrying (a) throughput, (b) the static
+    `grad_sync_census` of the compiled step — gradient-sized collective
+    count and wire dtypes, the proof the mode is engaged — (c) the
+    `wire_bytes_per_replica` accounting of the mode (the gather-form int8's
+    ~(n-1)·S growth and the multihop form's flat ~2·S as RECORDED numbers,
+    not docstring claims), and (d) the trace-derived exposed-comm fraction
+    (`comm_overlap_split`), the overlap-efficiency number DDP users read
+    off nsys timelines. `--bucket-cap-mb` sets the cap (default 25, DDP's
+    default); `--grad-accum` > 1 exercises the in-scan overlap (plus a
+    no-overlap arm isolating its win).
     """
+    from ..parallel.grad_sync import wire_bytes_for_config
+    from ..parallel.mesh import batch_shard_count
     from .harness import trace_exposed_comm
     from .trace_analysis import grad_sync_census, preopt_hlo_text
 
@@ -306,14 +311,16 @@ def run_grad_sync(args) -> List[dict]:
         modes.append(("bucketed_fp32_no_overlap",
                       dict(bucket_cap_mb=cap, overlap_grad_sync=False)))
     modes += [("bucketed_bf16", dict(bucket_cap_mb=cap, wire_dtype="bf16")),
-              ("bucketed_int8", dict(bucket_cap_mb=cap, wire_dtype="int8"))]
+              ("bucketed_int8", dict(bucket_cap_mb=cap, wire_dtype="int8")),
+              ("bucketed_int8_multihop",
+               dict(bucket_cap_mb=cap, wire_dtype="int8_multihop"))]
 
     rows = []
     for mode, gs in modes:
         gs_full = dict(gs or {}, grad_accum=accum) if (gs or accum > 1) \
             else gs
-        trainer, state, _, batch, gb = _setup(devices, args.bf16, args,
-                                              grad_sync=gs_full)
+        trainer, state, mesh, batch, gb = _setup(devices, args.bf16, args,
+                                                 grad_sync=gs_full)
         key = jax.random.PRNGKey(0)
         lowered = trainer._train_step.lower(state, batch, key)
         compiled = lowered.compile()
@@ -343,11 +350,16 @@ def run_grad_sync(args) -> List[dict]:
             return tr, st, ba
 
         exposed = trace_exposed_comm(_sacrificial, key=key)
+        # the mode's per-replica wire accounting: the implicit path syncs
+        # the same gradient bytes an uncapped fp32 reducer would
+        wire_bytes = wire_bytes_for_config(state.params, gs_full,
+                                           batch_shard_count(mesh))
         rows.append({
             "mode": mode,
             "global_samples_per_s": round(sps, 1),
             "grad_collectives": census["n_collectives"],
             "wire_dtypes": "+".join(sorted(wire)) or "-",
+            "wire_bytes_per_replica": wire_bytes,
             "exposed_comm_pct": exposed if exposed is not None else "-",
         })
     return rows

@@ -30,10 +30,18 @@ explicit, with the three levers DDP exposes (and two it doesn't):
   vs ~8·S for an uncompressed fp32 all-reduce, so the byte saving is real
   only for small DP degrees (break-even near n=9); the zero1 int8 scatter
   (s8 all-to-all, ~1 B/element regardless of n) does not have this
-  scaling. The n-independent fix for the bucketed path — multi-hop
-  reduce-scatter with REQUANTIZATION of the partial sums before the
-  gather hop (DynamiQ's scheme) — costs a second collective per bucket
-  and is the ROADMAP follow-up.
+  scaling. ``int8_multihop`` is the n-independent fix for the bucketed
+  path (DynamiQ's multi-hop scheme, arxiv 2602.08923): each bucket is
+  padded to the shard count, quantized PER DESTINATION CHUNK (one scale
+  per chunk, so each receiver dequantizes exactly the chunks it sums),
+  reduce-scattered as s8 over an all-to-all (hop 1, error feedback on
+  this first quantization), dequant-summed locally in fp32, then the
+  partial sum is REQUANTIZED and all-gathered as s8 (hop 2) — exactly
+  two gradient-sized collectives per bucket and ~2 wire bytes/element
+  regardless of n (`wire_bytes_per_replica` is the accounting). Hop 2
+  is a broadcast of identical data, so its quantization error is the
+  SAME perturbation on every replica — a bounded per-step bias (no
+  divergence), not covered by EF (the hop-1 residual is).
 * **Overlap** is the caller's third lever: `training/loop.py` reduces
   microbatch *i*'s buckets INSIDE the grad-accum scan body, so the
   collective for step *i* has no data dependency on step *i+1*'s compute
@@ -59,7 +67,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-WIRE_DTYPES = ("fp32", "bf16", "int8")
+WIRE_DTYPES = ("fp32", "bf16", "int8", "int8_multihop")
+
+# Wire modes whose codec carries an error-feedback residual (built by
+# Trainer.init_state into TrainState.grad_sync).
+EF_WIRE_DTYPES = ("int8", "int8_multihop")
 
 # Quantization grid half-width: int8 values in [-127, 127] (symmetric; -128
 # unused so the grid is zero-centered and dequantization is a pure scale).
@@ -120,6 +132,69 @@ def build_bucket_plan(params: Any, bucket_cap_mb: float) -> BucketPlan:
     return plan
 
 
+def padded_bucket_bounds(plan: BucketPlan, n_shards: int) -> Tuple[int, ...]:
+    """Cumulative offsets of the multihop wire layout: each bucket padded up
+    to a multiple of ``n_shards`` (the all-to-all needs equal destination
+    chunks). This is the layout of the hop-1 error-feedback residual — one
+    padded slot per bucket element INCLUDING the pad tail, so the residual
+    slices align with the codec's padded view of each bucket."""
+    bounds = [0]
+    for size in plan.bucket_sizes():
+        bounds.append(bounds[-1] + -(-size // n_shards) * n_shards)
+    return tuple(bounds)
+
+
+def padded_total_size(plan: BucketPlan, n_shards: int) -> int:
+    """Total elements of the multihop (padded-to-n) flat layout — the hop-1
+    residual length `ef_state_bucketed` allocates per replica."""
+    return padded_bucket_bounds(plan, n_shards)[-1]
+
+
+def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
+                           n_shards: int) -> int:
+    """Per-replica wire bytes of ONE full gradient sync under `wire_dtype` —
+    the accounting behind the mode table (README) as a measured/recorded
+    number in bench and scaling rows, not a docstring claim.
+
+    Conventions (payload only — the fp32 scale sideband, O(n) bytes per
+    bucket, is excluded as noise):
+
+    * ``fp32``/``bf16`` ride a ring all-reduce: ~2 hops x dtype bytes x S
+      (the large-n ring volume 2·(n-1)/n·S rounds up to 2·S) — 8·S and 4·S.
+    * ``int8`` (gather form): every replica RECEIVES each peer's full-size
+      s8 codes — (n-1)·S bytes, growing with the DP degree (break-even vs
+      fp32 near n=9).
+    * ``int8_multihop``: hop 1 all-to-all moves ~S_padded s8 bytes, hop 2
+      all-gather moves ~S_padded s8 bytes — 2·S_padded, independent of n
+      (padding adds < n elements per bucket).
+    """
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(f"unknown wire dtype {wire_dtype!r} "
+                         f"(choose from {WIRE_DTYPES})")
+    if n_shards <= 1:
+        return 0  # passthrough: nothing rides the wire
+    s = plan.total_size
+    if wire_dtype == "fp32":
+        return 8 * s
+    if wire_dtype == "bf16":
+        return 4 * s
+    if wire_dtype == "int8":
+        return (n_shards - 1) * s
+    return 2 * padded_total_size(plan, n_shards)
+
+
+def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
+                          n_shards: int) -> int:
+    """`wire_bytes_per_replica` from a TrainConfig-style override dict
+    (``bucket_cap_mb`` / ``wire_dtype``, with the TrainConfig defaults) —
+    the ONE accounting call both bench (`harness.measure_config`) and
+    scaling (`run_grad_sync`) record, so their rows cannot drift apart."""
+    cfg = dict(grad_sync_cfg or {})
+    plan = build_bucket_plan(params, float(cfg.get("bucket_cap_mb", 0.0)))
+    return wire_bytes_per_replica(plan, cfg.get("wire_dtype", "fp32"),
+                                  n_shards)
+
+
 def flatten_tree(tree: Any) -> jnp.ndarray:
     """Concatenate every leaf (ravelled, cast fp32) in tree-leaves order —
     the master flat gradient the buckets slice. This fixed order IS the
@@ -149,11 +224,21 @@ def unflatten_tree(flat: jnp.ndarray, like: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
+def _quantize_int8_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Row-wise symmetric quantization of a (n, chunk) matrix: one fp32
+    max-abs scale PER ROW (= per destination chunk), int8 codes. The single
+    quantization-grid definition every int8 wire shares."""
+    scales = jnp.maximum(jnp.max(jnp.abs(rows), axis=1), 1e-30) / _QMAX
+    q = jnp.clip(jnp.round(rows / scales[:, None]),
+                 -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scales
+
+
 def _quantize_int8(v: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(int8 codes, fp32 scale): symmetric per-bucket max-abs scaling."""
-    scale = jnp.maximum(jnp.max(jnp.abs(v)), 1e-30) / _QMAX
-    q = jnp.clip(jnp.round(v / scale), -_QMAX, _QMAX).astype(jnp.int8)
-    return q, scale
+    """(int8 codes, fp32 scale): symmetric per-bucket max-abs scaling —
+    the one-row case of `_quantize_int8_rows`."""
+    q, scales = _quantize_int8_rows(v[None])
+    return q[0], scales[0]
 
 
 def _int8_gather_sum(q: jnp.ndarray, scale: jnp.ndarray,
@@ -175,6 +260,67 @@ def _int8_gather_sum(q: jnp.ndarray, scale: jnp.ndarray,
     return jnp.sum(per_replica * scales[:, None], axis=0)
 
 
+def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
+                       axis_names: Sequence[str], n_shards: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """DynamiQ-style two-hop compressed SUM of one bucket: s8 all-to-all
+    reduce-scatter, local fp32 dequant-sum, requantize, s8 all-gather.
+
+    ``v``: this replica's (S,) fp32 bucket contribution. ``residual``: the
+    (S_padded,) hop-1 error-feedback residual (S_padded = S rounded up to a
+    multiple of ``n_shards``). Returns ``(fp32 (S,) global sum, new
+    residual)``.
+
+    Hop 1 quantizes PER DESTINATION CHUNK — one scale per (n_shards,)-row
+    of the padded bucket — so replica j dequantizes each received chunk
+    with exactly the scale its sender used for chunk j (a per-bucket scale
+    would make the receiver's dequant depend on elements it never sees).
+    The s8 all-to-all moves each chunk to its owner (~S_padded wire bytes);
+    the scales ride a tiny fp32 all-to-all (n scalars, under any census
+    floor). Error feedback covers THIS quantization: the residual is what
+    this replica's codes dropped, re-injected at its next reduction, so the
+    hop-1 bias telescopes across steps.
+
+    Hop 2 requantizes the fp32 partial sum of the n received chunks (one
+    scale for this replica's chunk) and all-gathers the codes
+    (~S_padded wire bytes) + scales (n fp32 scalars). Every replica
+    dequantizes the same (codes, scales), so the result is exactly
+    replicated. Hop-2 error is NOT error-fed-back — the partial sum is
+    owned by one replica but consumed by all, so a residual would have to
+    ride the wire to help; instead the error is bounded (<= scale2/2 per
+    element, scale2 = maxabs(partial)/127) and identical everywhere,
+    a per-step perturbation like the bf16 wire's (PARITY.md documents it).
+
+    Total: exactly TWO gradient-sized collectives per bucket and ~2 wire
+    bytes/element regardless of n — the census bound
+    `analysis.contracts.collectives_per_bucket("int8_multihop") == 2`.
+    """
+    names = tuple(axis_names)
+    size = v.shape[0]
+    padded = residual.shape[0]
+    chunk = padded // n_shards
+    carried = jnp.pad(v, (0, padded - size)) + residual
+    rows = carried.reshape(n_shards, chunk)
+    q, scales = _quantize_int8_rows(rows)
+    new_residual = carried - (q.astype(jnp.float32)
+                              * scales[:, None]).reshape(-1)
+    # hop 1: replica j receives every peer's chunk j (+ the scale each
+    # peer used for chunk j) — an s8 reduce-scatter, sum deferred to fp32
+    recv_q = lax.all_to_all(q.reshape(-1), names, split_axis=0,
+                            concat_axis=0, tiled=True)  # (padded,) s8
+    recv_scales = lax.all_to_all(scales, names, split_axis=0,
+                                 concat_axis=0, tiled=True)  # (n,) fp32
+    partial = jnp.sum(recv_q.reshape(n_shards, chunk).astype(jnp.float32)
+                      * recv_scales[:, None], axis=0)  # (chunk,) fp32
+    # hop 2: requantize the partial sum, gather codes + scales, dequant
+    q2, scale2 = _quantize_int8(partial)
+    gathered = lax.all_gather(q2, names, axis=0, tiled=True)  # (padded,) s8
+    g_scales = lax.all_gather(scale2[None], names, axis=0, tiled=True)
+    out = (gathered.reshape(n_shards, chunk).astype(jnp.float32)
+           * g_scales[:, None]).reshape(-1)
+    return out[:size], new_residual
+
+
 def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
                      n_shards: int, wire_dtype: str,
                      residual: Optional[jnp.ndarray]
@@ -193,6 +339,10 @@ def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
         # the caller keeps the fp32 master copy
         return lax.psum(v.astype(jnp.bfloat16), names).astype(jnp.float32), \
             residual
+    if wire_dtype == "int8_multihop":
+        raise ValueError("int8_multihop buckets reduce via "
+                         "_int8_multihop_sum (reduce_flat routes them — "
+                         "the residual layout is padded-to-n, not flat)")
     if wire_dtype != "int8":
         raise ValueError(f"unknown wire dtype {wire_dtype!r} "
                          f"(choose from {WIRE_DTYPES})")
@@ -213,17 +363,28 @@ def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
 
     ``flat``: this replica's (total_size,) fp32 contribution (weight-scaled
     gradient sums). Returns the globally-summed fp32 vector and the updated
-    error-feedback residual (same shape, int8 wire only). One collective per
-    bucket — the O(buckets) contract `grad_sync_census` verifies in HLO.
+    error-feedback residual (int8 wires only; same shape for ``int8``, the
+    `padded_bucket_bounds` layout for ``int8_multihop``). One collective
+    per bucket (TWO for the multi-hop wire) — the O(buckets) contract
+    `grad_sync_census` verifies in HLO.
     """
+    multihop = wire_dtype == "int8_multihop"
+    if multihop and residual is None:
+        raise ValueError("int8_multihop wire needs a hop-1 error-feedback "
+                         "residual (Trainer.init_state builds it)")
+    pbounds = padded_bucket_bounds(plan, n_shards) if multihop else None
     outs: List[jnp.ndarray] = []
     res_outs: List[jnp.ndarray] = []
-    for a, b in zip(plan.bounds, plan.bounds[1:]):
+    for k, (a, b) in enumerate(zip(plan.bounds, plan.bounds[1:])):
         v = lax.slice_in_dim(flat, a, b)
-        r = (lax.slice_in_dim(residual, a, b)
-             if residual is not None else None)
-        summed, new_r = _compressed_psum(v, axis_names, n_shards,
-                                         wire_dtype, r)
+        if multihop:
+            r = lax.slice_in_dim(residual, pbounds[k], pbounds[k + 1])
+            summed, new_r = _int8_multihop_sum(v, r, axis_names, n_shards)
+        else:
+            r = (lax.slice_in_dim(residual, a, b)
+                 if residual is not None else None)
+            summed, new_r = _compressed_psum(v, axis_names, n_shards,
+                                             wire_dtype, r)
         outs.append(summed)
         if new_r is not None:
             res_outs.append(new_r)
@@ -256,6 +417,11 @@ def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
         return lax.psum_scatter(v.astype(jnp.bfloat16), names,
                                 scatter_dimension=0,
                                 tiled=True).astype(jnp.float32), residual
+    if wire_dtype == "int8_multihop":
+        raise ValueError(
+            "int8_multihop is a bucketed-reducer wire: the zero1 scatter "
+            "half is ALREADY the n-independent s8 all-to-all (~1 B/element "
+            "via wire_dtype='int8') — there is no second hop to add here")
     if wire_dtype != "int8":
         raise ValueError(f"unknown wire dtype {wire_dtype!r} "
                          f"(choose from {WIRE_DTYPES})")
@@ -296,13 +462,25 @@ def _born_sharded_zeros(structs: Any, mesh):
     return make()
 
 
-def ef_state_bucketed(params: Any, mesh, n_shards: int):
+def ef_state_bucketed(params: Any, mesh, n_shards: int,
+                      bucket_cap_mb: float = 0.0,
+                      wire_dtype: str = "int8"):
     """Per-replica error-feedback residual for the bucketed reducer: one
-    (n_shards, total_size) fp32 array, row r = replica r's residual,
-    sharded over the batch axes so each replica materializes only its row.
+    (n_shards, R) fp32 array, row r = replica r's residual, sharded over
+    the batch axes so each replica materializes only its row. R is the
+    flat gradient size for the ``int8`` gather wire; for ``int8_multihop``
+    it is the `padded_bucket_bounds` layout (each bucket padded to a
+    multiple of n_shards — the hop-1 residual lives in the codec's padded
+    view, so the bucket cap and wire dtype size the buffer). Consequence:
+    a multihop residual is only meaningful under the bucket plan it was
+    built for — resuming a multihop checkpoint with a different
+    ``bucket_cap_mb`` is unsupported (the step rejects mismatched residual
+    lengths; keep the cap or rebuild the state and let EF restart from
+    zero residuals).
     """
-    total = int(sum(np.prod(np.shape(leaf)) or 1
-                    for leaf in jax.tree_util.tree_leaves(params)))
+    plan = build_bucket_plan(params, bucket_cap_mb)
+    total = (padded_total_size(plan, n_shards)
+             if wire_dtype == "int8_multihop" else plan.total_size)
     struct = jax.ShapeDtypeStruct((n_shards, total), jnp.float32)
     return {"ef": _born_sharded_zeros(struct, mesh)}
 

@@ -39,8 +39,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
 from ..parallel.grad_sync import (
-    WIRE_DTYPES, build_bucket_plan, compressed_psum_scatter, ef_state_bucketed,
-    ef_state_zero1, flatten_tree, reduce_flat, unflatten_tree,
+    EF_WIRE_DTYPES, WIRE_DTYPES, build_bucket_plan, compressed_psum_scatter,
+    ef_state_bucketed, ef_state_zero1, flatten_tree, padded_total_size,
+    reduce_flat, unflatten_tree,
 )
 from ..parallel.mesh import BATCH_AXES, batch_shard_count
 from ..parallel.sharding import (
@@ -84,13 +85,18 @@ class TrainConfig:
     # checkpoint format).
     bucket_cap_mb: float = 0.0
     # Gradient wire dtype: "fp32" (exact), "bf16" (half the wire bytes,
-    # bf16 accumulation on the wire — bounded error), or "int8" (per-
-    # bucket max-abs scales + error feedback carrying the quantization
-    # residual to the next step; the bucketed form is gather-based, a byte
-    # win at small DP degrees — see grad_sync.py's accounting). Master
+    # bf16 accumulation on the wire — bounded error), "int8" (per-bucket
+    # max-abs scales + error feedback carrying the quantization residual
+    # to the next step; the bucketed form is gather-based, a byte win at
+    # small DP degrees), or "int8_multihop" (DynamiQ's two-hop form: s8
+    # all-to-all reduce-scatter with hop-1 error feedback, requantize the
+    # partial sums, s8 all-gather — 2 collectives/bucket, ~2 B/element
+    # regardless of the DP degree; see grad_sync.py's accounting). Master
     # accumulation and the optimizer always run fp32. Any non-fp32 value
-    # engages the explicit reducer; composes with zero1 (the reduce-
-    # scatter half compresses via s8 all-to-all, n-independently).
+    # engages the explicit reducer; "bf16"/"int8" compose with zero1 (the
+    # reduce-scatter half compresses via s8 all-to-all, n-independently);
+    # "int8_multihop" + zero1 is rejected (zero1's scatter is already
+    # n-independent — nothing for a second hop to buy).
     wire_dtype: str = "fp32"
     # In grad-accum mode, reduce microbatch i's buckets INSIDE the scan
     # body (no data dependency on microbatch i+1's compute, so XLA can
@@ -126,6 +132,13 @@ class Trainer:
         if config.bucket_cap_mb < 0:
             raise ValueError(
                 f"bucket_cap_mb must be >= 0, got {config.bucket_cap_mb}")
+        if config.zero1 and config.wire_dtype == "int8_multihop":
+            raise ValueError(
+                "wire_dtype='int8_multihop' is the bucketed reducer's "
+                "n-independent wire; zero1's reduce-scatter half is ALREADY "
+                "n-independent as an s8 all-to-all — use zero1 with "
+                "wire_dtype='int8' (composing multihop with the zero1 "
+                "gather is a ROADMAP item)")
         if config.zero1 and config.bucket_cap_mb > 0:
             raise ValueError(
                 "bucket_cap_mb is the bucketed reducer of the replicated "
@@ -329,6 +342,11 @@ class Trainer:
           per-step perturbation, convergence pinned on the tiny-LM task.
         * int8 wire: per-bucket max-abs quantization with error feedback —
           biased per step, telescoping across steps; convergence pinned.
+        * int8_multihop wire: TWO quantizations per bucket — hop 1
+          per-destination-chunk with error feedback (telescoping, like
+          int8), hop 2 on the requantized partial sum (a bounded per-step
+          perturbation, identical on every replica, NOT fed back —
+          grad_sync.py documents the bound); convergence pinned.
         * stochastic tasks / BatchNorm: the zero1 caveats verbatim (each
           shard folds its index into the step RNG; BN normalizes by
           per-shard statistics, torch DDP's per-GPU BN semantics).
@@ -340,12 +358,31 @@ class Trainer:
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
         outer = state
         plan = build_bucket_plan(state.params, cfg.bucket_cap_mb)
-        use_ef = wire == "int8"
+        use_ef = wire in EF_WIRE_DTYPES
         if use_ef and not state.grad_sync:
             raise ValueError(
-                "wire_dtype='int8' needs error-feedback buffers — build "
+                f"wire_dtype={wire!r} needs error-feedback buffers — build "
                 "the state via Trainer.init_state (TrainState.grad_sync is "
                 "empty)")
+        if use_ef:
+            # The residual layout is plan-dependent for the multihop wire
+            # (padded_bucket_bounds of THIS bucket_cap_mb): a checkpoint
+            # resumed under a different cap would silently re-inject stale
+            # error at the wrong elements — fail loudly on the size
+            # mismatch instead. (Same-size different-layout collisions are
+            # possible in principle; changing the cap across a multihop
+            # resume is unsupported, documented at ef_state_bucketed.)
+            expect = (padded_total_size(plan, n) if wire == "int8_multihop"
+                      else plan.total_size)
+            got = state.grad_sync["ef"].shape[-1]
+            if got != expect:
+                raise ValueError(
+                    f"error-feedback residual length {got} does not match "
+                    f"the {wire!r} wire's layout for bucket_cap_mb="
+                    f"{cfg.bucket_cap_mb} ({expect} elements) — the state "
+                    "was built (or checkpointed) under a different bucket "
+                    "plan; rebuild via Trainer.init_state or restore with "
+                    "the original bucket_cap_mb")
 
         rep = P()
         batch_specs = jax.tree_util.tree_map(
@@ -356,7 +393,8 @@ class Trainer:
             inner = outer.replace(step=step, params=params,
                                   batch_stats=stats, opt_state=opt_state)
             idx = lax.axis_index(axes)
-            ef_l = maybe_ef[0][0] if use_ef else None  # (S,) local residual
+            # local residual: (S,) for int8, (S_padded,) for int8_multihop
+            ef_l = maybe_ef[0][0] if use_ef else None
 
             def micro_grads(mb, k):
                 def loss_fn(p):
@@ -691,11 +729,13 @@ class Trainer:
         variables = model.init(init_rng, x, train=False)
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
-        # int8 gradient wire: zero-initialized error-feedback residuals,
+        # int8 gradient wires: zero-initialized error-feedback residuals,
         # attached AFTER mesh placement (they carry their own per-replica
-        # sharding; the rules would replicate them).
-        use_ef = (self.config.wire_dtype == "int8"
-                  and (self._zero1 or self._grad_sync))
+        # sharding; the rules would replicate them). zero1 feeds back only
+        # under the gather-form "int8" (multihop is rejected at __init__).
+        use_ef = ((self.config.wire_dtype == "int8" and self._zero1)
+                  or (self.config.wire_dtype in EF_WIRE_DTYPES
+                      and self._grad_sync))
         if self._zero1:
             # Params stay replicated (the DDP layout — zero1 shards only
             # the UPDATE); the optimizer state is born flat-padded-sharded
@@ -718,7 +758,9 @@ class Trainer:
         placed = shard_pytree(state, self.mesh, self.rules)
         if use_ef:
             placed = placed.replace(grad_sync=ef_state_bucketed(
-                params, self.mesh, self._zero1_n))
+                params, self.mesh, self._zero1_n,
+                bucket_cap_mb=self.config.bucket_cap_mb,
+                wire_dtype=self.config.wire_dtype))
         return placed
 
     # -- epoch loops -------------------------------------------------------

@@ -93,15 +93,20 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "scheduled sync (the default). Incompatible "
                              "with --zero1")
     parser.add_argument("--wire-dtype", default="fp32", type=str,
-                        choices=["fp32", "bf16", "int8"],
+                        choices=["fp32", "bf16", "int8", "int8_multihop"],
                         help="gradient wire dtype for the explicit sync "
                              "path: bf16 halves the wire bytes; int8 adds "
                              "per-bucket scales + error feedback (bucketed "
                              "form is gather-based — a byte win at small "
-                             "DP degrees, break-even ~9 replicas); master "
+                             "DP degrees, break-even ~9 replicas); "
+                             "int8_multihop is the n-independent DynamiQ "
+                             "form (s8 reduce-scatter, requantize, s8 "
+                             "all-gather — 2 collectives/bucket, ~2 "
+                             "B/element at any DP degree); master "
                              "accumulation and the optimizer stay fp32. "
-                             "Composes with --zero1 (the reduce-scatter "
-                             "half compresses, n-independently)")
+                             "bf16/int8 compose with --zero1 (the reduce-"
+                             "scatter half compresses, n-independently); "
+                             "int8_multihop + --zero1 is rejected")
     parser.add_argument("--no-overlap-grad-sync", action="store_true",
                         help="with --bucket-cap-mb and --grad-accum > 1: "
                              "reduce buckets once after the microbatch "

@@ -19,7 +19,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     statuses = report["contracts"]
     assert set(statuses) == {"dp", "dp_accum", "zero1", "zero1_bf16",
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
-                             "gsync_bf16_accum"}
+                             "gsync_bf16_accum", "gsync_int8_mh",
+                             "gsync_int8_mh_accum"}
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran
     kinds = {r for r in report["rules_run"]}

@@ -323,3 +323,49 @@ class TestMultihopBound:
             verify_grad_sync_collectives(
                 text, total_grad_bytes=total_bytes, bucket_cap_mb=cap,
                 wire_dtype="int8", min_elements=1024)
+
+    def test_mutation_single_collective_impostor_flags(self):
+        """A single-hop codec MISLABELED as multihop (one gather-based
+        collective per bucket — the ISSUE-4 impostor) sails under the
+        2/bucket upper bound, so the hop SIGNATURE must catch it: no
+        gradient-sized all-to-all/reduce-scatter means hop 1 is missing."""
+        n_buckets, cap = 4, 0.125
+        total_bytes = n_buckets * 131072
+        lines = [f"  %ag.{i} = s8[262144]{{0}} "
+                 f"all-gather(s8[32768]{{0}} %q.{i})"
+                 for i in range(n_buckets)]
+        text = _module(lines)
+        with pytest.raises(AssertionError, match="hop 1 .* missing"):
+            verify_grad_sync_collectives(
+                text, total_grad_bytes=total_bytes, bucket_cap_mb=cap,
+                wire_dtype="int8_multihop", min_elements=1024)
+        # the same impostor through the rule engine (the matrix's view)
+        a = StepArtifacts(
+            name="impostor", optimized_text=text,
+            config=dict(bucket_cap_mb=cap, wire_dtype="int8_multihop"),
+            n_shards=8, total_grad_bytes=total_bytes, min_elements=1024)
+        found = check_artifacts(a, rules=["grad-sync-bucket-bound"])
+        assert found and "hop 1" in found[0].message
+        # ...and a scatter-only impostor is caught as a missing hop 2
+        lines = [f"  %rs.{i} = s8[4096]{{0}} "
+                 f"all-to-all(s8[32768]{{0}} %g.{i})"
+                 for i in range(n_buckets)]
+        with pytest.raises(AssertionError, match="hop 2 .* missing"):
+            verify_grad_sync_collectives(
+                _module(lines), total_grad_bytes=total_bytes,
+                bucket_cap_mb=cap, wire_dtype="int8_multihop",
+                min_elements=1024)
+
+    def test_multihop_contracts_in_matrix(self):
+        """The canonical matrix carries the multihop configs (the checker
+        gates the mode in tier-1, not just in this file's synthetics)."""
+        from distributed_pytorch_training_tpu.analysis.contracts import (
+            get_contract,
+        )
+
+        for name, accum in (("gsync_int8_mh", 1), ("gsync_int8_mh_accum", 2)):
+            c = get_contract(name)
+            assert c.config["wire_dtype"] == "int8_multihop"
+            assert c.config.get("grad_accum", 1) == accum
+            assert c.min_shards == 2
+            assert c.config["bucket_cap_mb"] > 0

@@ -117,6 +117,8 @@ def test_experiment_grad_sync_smoke(capsys):
     out = capsys.readouterr().out
     assert "grad_collectives" in out
     assert "bucketed_bf16" in out and "bucketed_int8" in out
+    assert "bucketed_int8_multihop" in out
+    assert "wire_bytes_per_replica" in out
     assert "exposed_comm_pct" in out
 
 
