@@ -51,6 +51,17 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
+# The liveness layer (relay-port registry, probing, deathwatch) lives in
+# resilience/heartbeat.py — extracted from here so bench and train share one
+# source of truth for the 8082/8083/8087 port set and the ADVICE-r5 fixes
+# (1.5s/3-miss lethal probe, bounded PJRT close on partial death) can never
+# drift between two copies. heartbeat imports no jax at module scope, so
+# this is safe before backend bring-up.
+from distributed_pytorch_training_tpu.resilience.heartbeat import (  # noqa: E402
+    Deathwatch, LivenessPolicy, port_listening as _port_listening,
+    relay_ports as _relay_ports,
+)
+
 HISTORY_PATH = Path(__file__).resolve().parent / \
     "distributed_pytorch_training_tpu" / "experiments" / "results" / \
     "bench_history.jsonl"
@@ -146,32 +157,6 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _relay_ports() -> "list[int]":
-    """Configured local relay ports (DPT_RELAY_PORTS, default
-    8082/8083/8087 — the three ports CHIP_STATUS.md documents the relay
-    listening on; omitting 8087 left the deathwatch blind to an 8087-only
-    partial death, ADVICE r5 #1) — shared by _tunnel_status and the
-    deathwatch so the two liveness views can never diverge."""
-    return [int(p) for p in
-            os.environ.get("DPT_RELAY_PORTS", "8082,8083,8087").split(",")
-            if p.strip().isdigit()]
-
-
-def _port_listening(port: int, timeout: float = 0.2) -> bool:
-    """TCP connect probe of one local relay port. The 200ms default suits
-    the advisory _tunnel_status diagnosis; the LETHAL deathwatch probe
-    passes a longer timeout so a relay that is alive but slow to accept
-    (backlog full during a heavy compile/transfer) is not misread as dead
-    (ADVICE r5 #2)."""
-    import socket
-
-    try:
-        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
-            return True
-    except Exception:
-        return False
-
-
 def _tunnel_status() -> "str | None":
     """Liveness of the tunneled backend's local relay ports, if any.
 
@@ -218,136 +203,41 @@ def _start_relay_deathwatch(interval_s: "float | None" = None,
                             assume_tunneled: bool = False):
     """Abort the inner promptly when the local relay tunnel dies mid-run.
 
-    The tunneled backend's device RPCs and remote compiles ride localhost
-    relay ports; when the relay process dies, the client sleep-retries
-    UNAVAILABLE for tens of minutes (observed live twice: a 40-minute
-    gpt2_124m compile block on 03:19, a 24+-minute vit_b16 block on 12:09 —
-    CHIP_STATUS.md) until the parent watchdog SIGTERMs it, which also risks
-    wedging the server-side grant. A dead relay has no client-side remedy,
-    so blocking is pure loss: this daemon thread samples the armed relay
-    ports and, once ANY of them is closed on THREE consecutive samples
-    (partial relay death hangs compiles just like total death — observed
-    live 03:19), logs and `os._exit(70)`. Three misses with a 1.5s connect
-    timeout per probe (vs the advisory 200ms): a lethal abort must not fire
-    on a relay that is alive but slow to accept under load (ADVICE r5 #2).
-    The parent's crash-salvage branch (inner rc=70) then records and
-    reports any already-flushed measurement. Arms ONLY if some relay port
-    was listening at start — on non-tunneled machines (CPU tests, real
-    multi-host pods) it is a no-op. os._exit, not sys.exit: a clean PJRT
-    teardown through a dead socket is exactly the hang being escaped — but
-    when SOME armed port is still alive (partial death), a BOUNDED
-    best-effort PJRT client close runs first, because an abrupt exit while
-    holding the TPU claim over the still-live device port is the stuck-
-    grant scenario _stop_gently warns about (observed 12:10-12:56, hours to
-    clear; ADVICE r5 #3)."""
-    # Lethal action needs an authoritative signal: arm ONLY when
-    # DPT_RELAY_PORTS is explicitly set (the same line _tunnel_status
-    # draws). Default-port heuristics would let an unrelated dev service
-    # on 8082 of a non-tunneled machine kill a healthy run by restarting.
-    # The chunk runner / operator opts in by exporting DPT_RELAY_PORTS;
-    # alternatively the caller passes assume_tunneled=True once a
-    # successful backend probe on the TPU platform has CONFIRMED the
-    # tunnel (the driver's plain `python bench.py` sets no env).
-    if "DPT_RELAY_PORTS" not in os.environ and not assume_tunneled:
-        return None
-    # Watch only the ports that are LISTENING at arm time: a port already
-    # dead now means a tunnel that is already degraded — tripping on it
-    # immediately would be wrong. A partially dead relay (compile port
-    # down, device port up) DOES hang compiles (observed live 03:19:
-    # /remote_compile refused while the client retried 40 min), so ANY
-    # armed port going dark counts as a miss.
-    armed = [p for p in _relay_ports() if _port_listening(p, timeout=1.5)]
-    if not armed:
-        return None  # not a tunneled environment (or already dead at start)
-    interval = interval_s if interval_s is not None else \
-        float(os.environ.get("DPT_RELAY_WATCH_INTERVAL", "30"))
-    _log(f"bench: relay deathwatch armed on ports {armed} "
-         f"(interval {interval:g}s)")
+    The deathwatch itself (per-port 3-consecutive-miss counters probed with
+    a 1.5s connect timeout, bounded best-effort PJRT close on PARTIAL death,
+    `os._exit(70)`) now lives in resilience/heartbeat.py — the generalized
+    liveness layer this bench seeded; see Deathwatch/LivenessPolicy for the
+    full rationale (ADVICE r5 #1-#3, CHIP_STATUS.md incidents). This wrapper
+    keeps bench's gating and plumbing: arm ONLY when DPT_RELAY_PORTS is
+    explicitly set (default-port heuristics would let an unrelated dev
+    service on 8082 of a non-tunneled machine kill a healthy run by
+    restarting) or when the caller passes assume_tunneled=True after a
+    successful backend probe CONFIRMED the tunnel; and before the abort,
+    reap the in-flight backend probes — an orphaned probe mid-jax.devices()
+    would keep the TPU claim past the inner's death. The parent's
+    crash-salvage branch (inner rc=70) then records and reports any
+    already-flushed measurement."""
 
-    def watch():
-        # Per-port consecutive-miss counters: a lethal abort needs the SAME
-        # port dark on three samples in a row, each probed with a 1.5s
-        # connect timeout (the advisory 200ms probe misreads a saturated-
-        # but-alive relay). A global counter would let transient blips on
-        # different ports kill a healthy compile.
-        misses = {p: 0 for p in armed}
-        while True:
-            time.sleep(interval)
-            for p in armed:
-                misses[p] = (misses[p] + 1
-                             if not _port_listening(p, timeout=1.5) else 0)
-            dead = [p for p in armed if misses[p] >= 3]
-            if dead:
-                alive = [p for p in armed
-                         if p not in dead and _port_listening(p, timeout=1.5)]
-                _log(f"bench: relay tunnel DIED mid-run (ports {dead} "
-                     "closed on three consecutive samples) — exiting now "
-                     "instead of hanging in UNAVAILABLE retries until the "
-                     "watchdog SIGTERM; flushed measurements are salvaged "
-                     "by the parent (inner rc=70)")
-                # Reap our own subprocesses first (a backend probe may be
-                # blocked mid-jax.devices(): orphaning it would leave a
-                # stale claim-holder — the invariant _stop_gently exists
-                # for). signal.signal is main-thread-only, so no group
-                # SIGTERM from here; the live-probe registry names them.
-                # Flag-set is ordered against probe spawn by _PROBE_LOCK:
-                # after the lock releases, every live probe is registered
-                # and no new one can spawn.
-                with _PROBE_LOCK:
-                    _RELAY_DEAD.set()
-                for p in list(_LIVE_PROBES):
-                    _stop_gently(p, grace_s=5.0)
-                if alive:
-                    # PARTIAL death: this process may still hold the TPU
-                    # claim over a live device port, and an abrupt exit can
-                    # wedge the server-side grant for hours (observed
-                    # 12:10-12:56). Attempt a clean PJRT client close,
-                    # bounded to a few seconds — the dead port can hang any
-                    # teardown RPC, so the attempt runs in a daemon thread
-                    # we abandon at the deadline rather than join.
-                    _try_clean_pjrt_close(timeout_s=5.0)
-                os._exit(70)
+    def reap_probes(dead_ports, alive_ports):
+        # signal.signal is main-thread-only, so no group SIGTERM from the
+        # watch thread; the live-probe registry names the children.
+        # Flag-set is ordered against probe spawn by _PROBE_LOCK: after the
+        # lock releases, every live probe is registered and no new one can
+        # spawn (a probe launched in the reap-then-exit window would be
+        # orphaned by the abort holding the TPU claim).
+        _log("bench: flushed measurements are salvaged by the parent "
+             "(inner rc=70)")
+        with _PROBE_LOCK:
+            _RELAY_DEAD.set()
+        for p in list(_LIVE_PROBES):
+            _stop_gently(p, grace_s=5.0)
 
-    t = threading.Thread(target=watch, daemon=True, name="relay-deathwatch")
-    t.start()
-    return t
-
-
-def _try_clean_pjrt_close(timeout_s: float = 5.0) -> None:
-    """Best-effort, time-boxed release of the PJRT client (and with it the
-    server-side TPU grant) before a deathwatch abort on PARTIAL relay death.
-
-    Only meaningful when jax is already loaded and initialized in this
-    process (otherwise there is no claim to release — importing jax here
-    would CREATE one). The close itself can hang on the dead half of the
-    relay, so it runs in a daemon thread that os._exit abandons after
-    `timeout_s` — a bounded attempt, never a new hang (ADVICE r5 #3)."""
-    jax_mod = sys.modules.get("jax")
-    if jax_mod is None:
-        return
-    done = threading.Event()
-
-    def close():
-        try:
-            # clear_backends tears down the live PJRT client(s); the public
-            # name moved across jax versions, so probe both homes.
-            clear = getattr(jax_mod, "clear_backends", None)
-            if clear is None:
-                from jax.extend import backend as jex_backend
-                clear = getattr(jex_backend, "clear_backends", None)
-            if clear is not None:
-                clear()
-                _log("bench: PJRT client closed cleanly before abort")
-        except Exception as e:
-            _log(f"bench: clean PJRT close failed ({e}); aborting anyway")
-        finally:
-            done.set()
-
-    t = threading.Thread(target=close, daemon=True, name="pjrt-close")
-    t.start()
-    if not done.wait(timeout_s):
-        _log(f"bench: clean PJRT close still blocked after {timeout_s:.0f}s "
-             "— abandoning it (the dead relay port is unrecoverable)")
+    policy = LivenessPolicy(
+        interval_s=interval_s if interval_s is not None else
+        float(os.environ.get("DPT_RELAY_WATCH_INTERVAL", "30")))
+    return Deathwatch.arm(assume_tunneled=assume_tunneled, policy=policy,
+                          on_death=reap_probes,
+                          log=lambda m: _log(f"bench: {m}"))
 
 
 def _stop_gently(proc: subprocess.Popen, grace_s: float = 15.0,

@@ -376,6 +376,48 @@ def check_axis_name_registry(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule 5: os._exit only in resilience/heartbeat.py
+# ---------------------------------------------------------------------------
+
+# The one sanctioned home of the abrupt-exit primitive (hard_exit): the
+# deathwatch abort (a clean teardown through a dead socket IS the hang
+# being escaped) and preemption's hard deadline route through it.
+# Matched on exact trailing path COMPONENTS, not a string suffix — a
+# future `myresilience/heartbeat.py` must not inherit the exemption.
+OS_EXIT_HOME = ("resilience", "heartbeat.py")
+
+
+@rule("no-bare-os-exit", "ast",
+      "os._exit appears only in resilience/heartbeat.py (hard_exit)",
+      "an abrupt exit while this process holds the server-side TPU grant "
+      "wedges the chip for every later process (observed live: a "
+      "claim-holder killed without teardown left the device pool stuck "
+      "for hours). The legitimate abrupt exits — the relay deathwatch, "
+      "preemption's zombie-prevention deadline — live behind "
+      "resilience/heartbeat.py's hard_exit, which documents when an "
+      "abrupt exit is allowed and what cleanup it owes first; a bare "
+      "os._exit anywhere else is a new stuck-grant hazard.")
+def check_no_bare_os_exit(ctx: FileContext) -> List[Finding]:
+    if tuple(ctx.relpath.replace("\\", "/").split("/")[-2:]) == OS_EXIT_HOME:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        # flag the ATTRIBUTE access, not just calls: `ex = os._exit` then
+        # `ex(1)` is the same hazard with one extra hop
+        if isinstance(node, ast.Attribute) or isinstance(node, ast.Name):
+            resolved = ctx.resolve(node)
+            if resolved == "os._exit":
+                out.append(Finding(
+                    "no-bare-os-exit",
+                    "os._exit outside resilience/heartbeat.py — abrupt "
+                    "claim-holder death wedges the server-side TPU grant; "
+                    "use resilience.heartbeat.hard_exit (or the preemption "
+                    "guard's deadline) so the exit is accounted for",
+                    ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 

@@ -153,6 +153,12 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              config=dict(zero1=True), min_shards=2),
     Contract("zero1_bf16", "zero1 with the reduce-scatter half at bf16",
              config=dict(zero1=True, wire_dtype="bf16"), min_shards=2),
+    Contract("zero1_int8_mh",
+             "zero1 fully compressed: s8 all-to-all scatter (error "
+             "feedback) + s8 delta-quantized param all-gather "
+             "(quantized_delta_all_gather) — both halves off fp32",
+             config=dict(zero1=True, wire_dtype="int8_multihop"),
+             min_shards=2),
     Contract("gsync_fp32", "bucketed reducer, exact fp32 wire",
              config=dict(bucket_cap_mb=_CAP), min_shards=2),
     Contract("gsync_bf16", "bucketed reducer, bf16 wire",

@@ -45,7 +45,12 @@ class ShardedLoader:
         seed: int = 42,
         drop_last: bool = False,
         prefetch: int = 2,
+        fault_hook=None,
     ):
+        # resilience/faults.py injection point: called with the in-epoch
+        # step index before that step's batch is produced (loader_stall
+        # chaos). None on every un-instrumented run — zero hot-path cost.
+        self.fault_hook = fault_hook
         self.dataset = dataset
         self.mesh = mesh
         self.global_batch = per_device_batch * batch_shard_count(mesh)
@@ -66,7 +71,10 @@ class ShardedLoader:
     def _host_batches(self, epoch: int,
                       start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         images, labels = self.dataset.images, self.dataset.labels
-        for idx, w in self.sampler.iter_epoch(epoch, start_step):
+        for k, (idx, w) in enumerate(
+                self.sampler.iter_epoch(epoch, start_step)):
+            if self.fault_hook is not None:
+                self.fault_hook(start_step + k)
             yield {
                 "image": native.gather_rows(images, idx),
                 "label": labels[idx],
@@ -88,7 +96,9 @@ class ShardedLoader:
                 self.dataset.images, self.dataset.labels, idx, w,
                 depth=self.prefetch)
             try:
-                for img, lab, weight in pf:
+                for k, (img, lab, weight) in enumerate(pf):
+                    if self.fault_hook is not None:
+                        self.fault_hook(start_step + k)
                     yield shard_batch(
                         {"image": img, "label": lab, "weight": weight},
                         self.mesh)

@@ -41,7 +41,12 @@ explicit, with the three levers DDP exposes (and two it doesn't):
   regardless of n (`wire_bytes_per_replica` is the accounting). Hop 2
   is a broadcast of identical data, so its quantization error is the
   SAME perturbation on every replica — a bounded per-step bias (no
-  divergence), not covered by EF (the hop-1 residual is).
+  divergence), not covered by EF (the hop-1 residual is). On zero1,
+  ``int8_multihop`` means the FULLY compressed wire: the scatter half is
+  the s8 all-to-all of ``int8`` (error-fed-back), and the param
+  all-gather compresses as s8 UPDATE codes + per-chunk fp32 scales
+  (`quantized_delta_all_gather` — the hop-2 error model applied to the
+  parameter delta).
 * **Overlap** is the caller's third lever: `training/loop.py` reduces
   microbatch *i*'s buckets INSIDE the grad-accum scan body, so the
   collective for step *i* has no data dependency on step *i+1*'s compute
@@ -394,6 +399,45 @@ def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
     return synced, new_residual
 
 
+def quantized_delta_all_gather(new_shard: jnp.ndarray,
+                               old_shard: jnp.ndarray,
+                               old_flat: jnp.ndarray,
+                               axis_names: Sequence[str]) -> jnp.ndarray:
+    """Compressed zero1 PARAM all-gather (the `int8_multihop` composition):
+    gather s8 codes of each replica's UPDATE, not fp32 new params.
+
+    ``new_shard``/``old_shard``: this replica's (padded/n,) fp32 chunk of
+    one leaf's flat-padded parameters, after/before the optimizer update.
+    ``old_flat``: the full (padded,) flat-padded OLD parameters — replicated
+    in zero1 (the layout the mode shards is the update, not the model), so
+    every replica already holds them exactly. Each replica quantizes its
+    chunk's delta with one fp32 max-abs scale (the per-destination-chunk
+    rule of the multihop gradient wire, reused: the scale travels with the
+    codes it scales), all-gathers codes (s8 on the wire, ~1 B per fp32
+    param byte saved x4) + scales (n fp32 scalars, noise), and adds the
+    dequantized full delta to ``old_flat``.
+
+    Error model (the hop-2 story, verbatim): every replica dequantizes the
+    SAME (codes, scales), so the reconstructed parameters are exactly
+    replicated — quantization perturbs the trajectory by a bounded,
+    replica-identical amount per step (<= scale/2 per element, scale =
+    maxabs(update)/127 per chunk; the UPDATE is lr-sized, so the absolute
+    param error is ~lr * grad-scale / 254 per step). NOT error-fed-back:
+    the delta is owned by one replica but consumed by all, so a residual
+    would have to ride the wire to help; tests pin the 20-step fp32-parity
+    instead (tests/test_grad_sync.py).
+    """
+    names = tuple(axis_names)
+    delta = new_shard - old_shard
+    q, scale = _quantize_int8(delta)
+    gathered = lax.all_gather(q, names, axis=0, tiled=True)  # (padded,) s8
+    scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
+    n = scales.shape[0]
+    full_delta = (gathered.reshape(n, -1).astype(jnp.float32)
+                  * scales[:, None]).reshape(-1)
+    return old_flat + full_delta
+
+
 def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
                             n_shards: int, wire_dtype: str,
                             residual: Optional[jnp.ndarray] = None
@@ -419,9 +463,11 @@ def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
                                 tiled=True).astype(jnp.float32), residual
     if wire_dtype == "int8_multihop":
         raise ValueError(
-            "int8_multihop is a bucketed-reducer wire: the zero1 scatter "
-            "half is ALREADY the n-independent s8 all-to-all (~1 B/element "
-            "via wire_dtype='int8') — there is no second hop to add here")
+            "the zero1 scatter half is ALREADY the n-independent s8 "
+            "all-to-all: the zero1 step maps wire_dtype='int8_multihop' "
+            "to the 'int8' scatter codec before calling here (what "
+            "multihop adds on zero1 is the compressed param gather — "
+            "quantized_delta_all_gather)")
     if wire_dtype != "int8":
         raise ValueError(f"unknown wire dtype {wire_dtype!r} "
                          f"(choose from {WIRE_DTYPES})")

@@ -1,0 +1,35 @@
+"""resilience/ — fault-tolerant training: liveness, fault injection,
+checkpoint-restart supervision.
+
+The reference has no failure story (SURVEY.md §5: a crashed rank hangs the
+NCCL job). This subsystem turns "a fault happened" into "the run finished
+anyway", composing three pieces that previously existed only in isolation:
+
+* :mod:`.heartbeat` — the generalized relay-port liveness layer
+  (``Deathwatch`` + ``LivenessPolicy``), extracted from ``bench.py``'s
+  ADVICE-r5-hardened deathwatch so bench and train share ONE source of
+  truth for the 8082/8083/8087 relay-port set and the
+  bounded-PJRT-close-on-partial-death behavior.
+* :mod:`.faults` — deterministic fault injection (``FaultPlan`` /
+  ``FaultInjector``): ``crash@step=7``, ``sigterm@step=12``,
+  ``torn_ckpt@save=2``, ``loader_stall@step=5:2.5s``. Hooks thread through
+  ``training/loop.py``, the checkpoint save path, and ``data/loader.py``,
+  and are plain ``None`` when no plan is armed — the hot path is untouched.
+* :mod:`.supervisor` — the in-process restart supervisor wrapping the
+  epoch loop: on a step/save failure it restores the latest *valid*
+  checkpoint (``training/checkpoint.py`` manifest verification skips torn
+  ones), replays behind a step fence (no optimizer step double-applies;
+  same-seed data order via the deterministic sampler + ``state.step``-folded
+  RNG + restored EF residuals) and retries under a bounded
+  exponential-backoff-with-jitter ``RetryPolicy``, draining preemptions
+  gracefully instead of racing them.
+
+``python -m distributed_pytorch_training_tpu.resilience chaos`` (also the
+``resilience`` console script) runs a scripted fault schedule against a
+short CPU-mesh training run and reports recovery stats — the demo and the
+test harness in one.
+"""
+
+from .faults import FaultError, FaultInjector, FaultPlan  # noqa: F401
+from .heartbeat import Deathwatch, LivenessPolicy  # noqa: F401
+from .supervisor import RetryPolicy, RunReport, Supervisor  # noqa: F401

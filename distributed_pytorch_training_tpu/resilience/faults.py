@@ -1,0 +1,204 @@
+"""Deterministic fault injection: a parsed ``FaultPlan`` + one-shot hooks.
+
+Chaos testing for the training stack: the plan is a comma-separated spec
+(CLI ``--chaos`` / env ``DPT_CHAOS``) of faults pinned to exact trigger
+points, so every failure a test provokes is reproducible:
+
+* ``crash@step=7``        — raise :class:`FaultError` at the step-7 fence
+  (before the step executes; the optimizer never applies step 7).
+* ``sigterm@step=12``     — deliver a real SIGTERM to this process at the
+  step-12 fence (the preemption path, end to end through the installed
+  ``PreemptionGuard``).
+* ``torn_ckpt@save=2``    — truncate a data file of the 2nd checkpoint
+  save AFTER it finalized (simulates post-commit corruption: disk
+  truncation, a torn copy) so the manifest verification in
+  ``training/checkpoint.py`` must catch and skip it.
+* ``loader_stall@step=5:2.5s`` — sleep 2.5s in the data loader before
+  producing the batch of (in-epoch) step 5.
+
+Step indices are the ABSOLUTE global step (``state.step`` before the step
+executes, i.e. steps are 0-indexed from the start of the run) for ``crash``
+and ``sigterm``; ``loader_stall`` uses the in-epoch step index (the loader
+has no global-step view). ``save`` counts finalized saves, 1-indexed.
+
+Every fault fires ONCE: a crash at step k would otherwise re-fire on the
+replay of step k after restore and the run could never make progress.
+Hooks are threaded as plain optional callables (``training/loop.py``
+``fault_hook``, ``CheckpointManager(post_save_hook=...)``,
+``ShardedLoader(fault_hook=...)``) — when no plan is armed the hooks are
+``None`` and the hot path is untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Tuple
+
+CHAOS_ENV = "DPT_CHAOS"
+
+# kind -> the only trigger it accepts (a typo'd trigger must fail loudly).
+FAULT_KINDS = {
+    "crash": "step",
+    "sigterm": "step",
+    "loader_stall": "step",
+    "torn_ckpt": "save",
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@(?P<trigger>[a-z]+)=(?P<at>\d+)"
+    r"(?::(?P<arg>\d+(?:\.\d+)?)s?)?$")
+
+
+class FaultError(RuntimeError):
+    """An injected crash — the supervisor's restartable failure class."""
+
+
+def _stderr_log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str        # crash | sigterm | loader_stall | torn_ckpt
+    trigger: str     # "step" or "save"
+    at: int          # step index (0-based) or save count (1-based)
+    seconds: float = 0.0  # loader_stall duration
+
+    def label(self) -> str:
+        tail = f":{self.seconds:g}s" if self.kind == "loader_stall" else ""
+        return f"{self.kind}@{self.trigger}={self.at}{tail}"
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Immutable parsed plan; arm it by building a :class:`FaultInjector`."""
+
+    faults: Tuple[Fault, ...] = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> "FaultPlan":
+        """``"crash@step=7,torn_ckpt@save=2,loader_stall@step=5:2.5s"``.
+        Empty/None spec parses to the empty plan (nothing armed)."""
+        faults: List[Fault] = []
+        for item in filter(None, (s.strip()
+                                  for s in (spec or "").split(","))):
+            m = _SPEC_RE.match(item)
+            if not m:
+                raise ValueError(
+                    f"chaos fault {item!r} is not kind@trigger=N[:SECs] "
+                    f"(kinds: {sorted(FAULT_KINDS)})")
+            kind, trigger = m.group("kind"), m.group("trigger")
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown chaos fault kind {kind!r} "
+                                 f"(kinds: {sorted(FAULT_KINDS)})")
+            if trigger != FAULT_KINDS[kind]:
+                raise ValueError(
+                    f"chaos fault {kind!r} triggers on "
+                    f"{FAULT_KINDS[kind]!r}, not {trigger!r}")
+            seconds = float(m.group("arg") or 0.0)
+            if kind == "loader_stall" and seconds <= 0:
+                raise ValueError(
+                    f"loader_stall needs a duration ({item!r}; e.g. "
+                    "loader_stall@step=5:2.5s)")
+            if kind != "loader_stall" and m.group("arg"):
+                raise ValueError(
+                    f"chaos fault {kind!r} takes no :SECs argument ({item!r})")
+            faults.append(Fault(kind=kind, trigger=trigger,
+                                at=int(m.group("at")), seconds=seconds))
+        return cls(faults=tuple(faults))
+
+    @classmethod
+    def from_env(cls, env: str = CHAOS_ENV) -> "FaultPlan":
+        return cls.parse(os.environ.get(env))
+
+
+def tear_checkpoint(step_dir: Path,
+                    log: Callable[[str], None] = _stderr_log) -> Path:
+    """Truncate the largest data file under a FINALIZED checkpoint step dir
+    to half its size — the canonical torn checkpoint. Returns the torn
+    file's path. Raises when the dir holds no file (tearing nothing would
+    make a chaos run pass vacuously)."""
+    files = sorted((p for p in Path(step_dir).rglob("*") if p.is_file()),
+                   key=lambda p: p.stat().st_size, reverse=True)
+    if not files:
+        raise FileNotFoundError(f"no file to tear under {step_dir}")
+    victim = files[0]
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    log(f"chaos: TORE checkpoint file {victim} ({size} -> "
+        f"{victim.stat().st_size} bytes)")
+    return victim
+
+
+class FaultInjector:
+    """Armed, mutable state of one plan: each fault fires once, and what
+    fired is recorded (``fired`` / ``unfired()`` feed the recovery report).
+
+    The hook methods are what the stack calls:
+    ``on_step(step)`` from the trainer's step fence (``fault_hook``),
+    ``on_loader_batch(step)`` from the data loader, and
+    ``on_save(label, step_dir)`` from the checkpoint manager after a save
+    finalizes. All are cheap membership checks when nothing matches."""
+
+    def __init__(self, plan: FaultPlan,
+                 log: Callable[[str], None] = _stderr_log):
+        self.plan = plan
+        self.log = log
+        self._pending: List[Fault] = list(plan.faults)
+        self.fired: List[str] = []
+        self.saves_seen = 0
+        # the hooks fire from different threads (the step fence on the
+        # main thread, on_loader_batch from the loader's producer thread)
+        # and an unsynchronized take could skip a matching fault — the
+        # schedule must stay deterministic under prefetch
+        self._lock = threading.Lock()
+
+    def unfired(self) -> List[str]:
+        with self._lock:
+            return [f.label() for f in self._pending]
+
+    def _take(self, kind: str, at: int) -> Optional[Fault]:
+        with self._lock:
+            for f in self._pending:
+                if f.kind == kind and f.at == at:
+                    self._pending.remove(f)
+                    self.fired.append(f.label())
+                    return f
+            return None
+
+    def on_step(self, step: int) -> None:
+        """Step fence, called BEFORE global step ``step`` executes."""
+        if self._take("sigterm", step) is not None:
+            self.log(f"chaos: delivering SIGTERM at step {step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._take("crash", step) is not None:
+            self.log(f"chaos: injected crash at step {step}")
+            raise FaultError(f"injected crash@step={step}")
+
+    def on_loader_batch(self, step: int) -> None:
+        """Called by the loader before producing (in-epoch) step ``step``."""
+        f = self._take("loader_stall", step)
+        if f is not None:
+            self.log(f"chaos: stalling loader {f.seconds:g}s at step {step}")
+            time.sleep(f.seconds)
+
+    def on_save(self, label: int, step_dir: Path) -> None:
+        """Called by CheckpointManager after save ``label`` finalized (the
+        manifest is already written, so a tear here MUST be caught by the
+        integrity verification at restore time)."""
+        with self._lock:
+            self.saves_seen += 1
+            count = self.saves_seen
+        if self._take("torn_ckpt", count) is not None:
+            tear_checkpoint(Path(step_dir), log=self.log)

@@ -1,0 +1,255 @@
+"""Heartbeat liveness: relay-port probing + the deathwatch, as a library.
+
+Extracted from ``bench.py`` (where the ADVICE-r5 hardening landed) so bench
+and train share ONE source of truth for the tunneled backend's relay-port
+set and the abort behavior — the fixes (8087 in the defaults, the 1.5s/3-miss
+lethal probe, the bounded PJRT close on partial death) can never drift
+between two copies again.
+
+Background (CHIP_STATUS.md, twice observed live): the tunneled single-chip
+backend's device RPCs and remote compiles ride localhost relay ports
+(8082/8083/8087). When the relay process dies — totally OR partially (just
+the compile port) — the client sleep-retries UNAVAILABLE for tens of
+minutes with no exception to catch; there is no client-side remedy, so
+blocking is pure loss. The ``Deathwatch`` samples the armed ports and, once
+any of them is dark for ``max_misses`` consecutive samples, aborts the
+process promptly (``os._exit``, because a clean teardown through a dead
+socket is exactly the hang being escaped) — after a BOUNDED best-effort
+PJRT client close when some armed port is still alive, because an abrupt
+exit while holding the TPU claim over a live device port is the
+stuck-server-side-grant scenario that wedged the chip for hours.
+
+This module never imports jax at module scope: arming a watch must not
+initialize a backend (and linting must not need one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+# The three ports CHIP_STATUS.md documents the relay listening on; omitting
+# 8087 left the deathwatch blind to an 8087-only partial death (ADVICE r5 #1).
+DEFAULT_RELAY_PORTS = "8082,8083,8087"
+RELAY_PORTS_ENV = "DPT_RELAY_PORTS"
+WATCH_INTERVAL_ENV = "DPT_RELAY_WATCH_INTERVAL"
+
+# rc the deathwatch aborts with; parents (bench's watchdog) key their
+# crash-salvage branch on it.
+DEATHWATCH_EXIT_CODE = 70
+
+
+def _stderr_log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def relay_ports() -> List[int]:
+    """Configured local relay ports (``DPT_RELAY_PORTS``, default
+    8082/8083/8087) — THE port registry. Every liveness view (bench's
+    advisory ``_tunnel_status``, the lethal deathwatch, train's watch)
+    reads this one function so the views can never diverge."""
+    return [int(p) for p in
+            os.environ.get(RELAY_PORTS_ENV, DEFAULT_RELAY_PORTS).split(",")
+            if p.strip().isdigit()]
+
+
+def port_listening(port: int, timeout: float = 0.2) -> bool:
+    """TCP connect probe of one local relay port. The 200ms default suits
+    advisory diagnosis; LETHAL probes pass ``LivenessPolicy.connect_timeout_s``
+    (1.5s) so a relay that is alive but slow to accept (backlog full during
+    a heavy compile/transfer) is not misread as dead (ADVICE r5 #2)."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except Exception:
+        return False
+
+
+def hard_exit(code: int) -> None:
+    """The ONE sanctioned abrupt process exit (``os._exit``).
+
+    An abrupt exit is legitimate only when a clean teardown is itself the
+    hang being escaped (dead relay socket) or when a zombie would keep a
+    device claim (preemption's hard deadline) — and even then the caller
+    must have already attempted/bounded any cleanup it owes. Everywhere
+    else, ``os._exit`` while holding the server-side TPU grant wedges the
+    chip for every later process (observed live, hours to clear) — the
+    ``no-bare-os-exit`` analysis rule flags any other call site."""
+    os._exit(code)
+
+
+def try_clean_pjrt_close(timeout_s: float = 5.0,
+                         log: Callable[[str], None] = _stderr_log) -> None:
+    """Best-effort, time-boxed release of the PJRT client (and with it the
+    server-side TPU grant) before a deathwatch abort on PARTIAL relay death.
+
+    Only meaningful when jax is already loaded and initialized in this
+    process (otherwise there is no claim to release — importing jax here
+    would CREATE one). The close itself can hang on the dead half of the
+    relay, so it runs in a daemon thread that the abort abandons after
+    ``timeout_s`` — a bounded attempt, never a new hang (ADVICE r5 #3)."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return
+    done = threading.Event()
+
+    def close():
+        try:
+            # clear_backends tears down the live PJRT client(s); the public
+            # name moved across jax versions, so probe both homes.
+            clear = getattr(jax_mod, "clear_backends", None)
+            if clear is None:
+                from jax.extend import backend as jex_backend
+                clear = getattr(jex_backend, "clear_backends", None)
+            if clear is not None:
+                clear()
+                log("PJRT client closed cleanly before abort")
+        except Exception as e:
+            log(f"clean PJRT close failed ({e}); aborting anyway")
+        finally:
+            done.set()
+
+    t = threading.Thread(target=close, daemon=True, name="pjrt-close")
+    t.start()
+    if not done.wait(timeout_s):
+        log(f"clean PJRT close still blocked after {timeout_s:.0f}s "
+            "— abandoning it (the dead relay port is unrecoverable)")
+
+
+@dataclasses.dataclass(frozen=True)
+class LivenessPolicy:
+    """How a Deathwatch probes and what a death means.
+
+    ``interval_s``: seconds between probe rounds (default from
+    ``DPT_RELAY_WATCH_INTERVAL`` at arm time, 30 if unset).
+    ``connect_timeout_s``: per-probe TCP connect timeout — 1.5s for lethal
+    watches (the advisory 200ms misreads a saturated-but-alive relay,
+    ADVICE r5 #2). ``max_misses``: the SAME port must be dark this many
+    consecutive samples (per-port counters: transient blips on different
+    ports must not add up to a kill). ``lethal``: True aborts the process
+    with ``exit_code`` (after the bounded PJRT close on partial death);
+    False is advisory — the watch sets ``Deathwatch.died`` and stops, and
+    the owner (e.g. a supervisor loop) decides."""
+
+    interval_s: float = 30.0
+    connect_timeout_s: float = 1.5
+    max_misses: int = 3
+    lethal: bool = True
+    exit_code: int = DEATHWATCH_EXIT_CODE
+
+
+class Deathwatch:
+    """Watch the armed relay ports; act when the tunnel dies mid-run.
+
+    Use :meth:`arm` (the gated constructor) in entry points: it refuses to
+    arm off default-port heuristics — an unrelated dev service on 8082 of a
+    non-tunneled machine must never be able to kill a healthy run by
+    restarting. Arming requires ``DPT_RELAY_PORTS`` to be explicitly set,
+    OR ``assume_tunneled=True`` once a successful backend probe has
+    CONFIRMED the tunnel (bench does this after seeing the ``axon``
+    platform). Only ports LISTENING at arm time are watched: a port already
+    dead means an already-degraded tunnel — tripping on it immediately
+    would be wrong; but ANY armed port going dark counts (partial relay
+    death hangs compiles just like total death — observed live).
+
+    ``on_death(dead_ports, alive_ports)`` runs BEFORE the lethal abort —
+    bench uses it to reap in-flight backend probes so no orphan keeps the
+    TPU claim past the abort."""
+
+    def __init__(self, ports: Sequence[int],
+                 policy: LivenessPolicy = LivenessPolicy(),
+                 on_death: Optional[Callable[[List[int], List[int]], None]]
+                 = None,
+                 log: Callable[[str], None] = _stderr_log):
+        self.armed_ports = list(ports)
+        self.policy = policy
+        self.on_death = on_death
+        self.log = log
+        self.died = threading.Event()
+        self.dead_ports: List[int] = []
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def arm(cls, *, require_env: bool = True, assume_tunneled: bool = False,
+            policy: Optional[LivenessPolicy] = None,
+            on_death: Optional[Callable[[List[int], List[int]], None]] = None,
+            log: Callable[[str], None] = _stderr_log
+            ) -> Optional["Deathwatch"]:
+        """Gated arm-and-start. Returns the running watch, or None when the
+        environment did not opt in (no ``DPT_RELAY_PORTS`` and not
+        ``assume_tunneled``) or no armed port is listening (not a tunneled
+        environment, or the tunnel is already dead at start)."""
+        if require_env and RELAY_PORTS_ENV not in os.environ \
+                and not assume_tunneled:
+            return None
+        if policy is None:
+            policy = LivenessPolicy(
+                interval_s=float(os.environ.get(WATCH_INTERVAL_ENV, "30")))
+        armed = [p for p in relay_ports()
+                 if port_listening(p, timeout=policy.connect_timeout_s)]
+        if not armed:
+            return None
+        watch = cls(armed, policy=policy, on_death=on_death, log=log)
+        watch.start()
+        return watch
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self._watch, daemon=True,
+                             name="relay-deathwatch")
+        self._thread = t
+        t.start()
+        self.log(f"relay deathwatch armed on ports {self.armed_ports} "
+                 f"(interval {self.policy.interval_s:g}s)")
+        return t
+
+    def _watch(self) -> None:
+        # Per-port consecutive-miss counters: a lethal abort needs the SAME
+        # port dark on `max_misses` samples in a row, each probed with the
+        # policy's (long) connect timeout. A global counter would let
+        # transient blips on different ports kill a healthy compile.
+        pol = self.policy
+        misses = {p: 0 for p in self.armed_ports}
+        while True:
+            time.sleep(pol.interval_s)
+            for p in self.armed_ports:
+                misses[p] = (misses[p] + 1 if not port_listening(
+                    p, timeout=pol.connect_timeout_s) else 0)
+            dead = [p for p in self.armed_ports
+                    if misses[p] >= pol.max_misses]
+            if dead:
+                alive = [p for p in self.armed_ports if p not in dead
+                         and port_listening(p,
+                                            timeout=pol.connect_timeout_s)]
+                self._fire(dead, alive)
+                return
+
+    def _fire(self, dead: List[int], alive: List[int]) -> None:
+        pol = self.policy
+        self.dead_ports = dead
+        verb = ("exiting now instead of hanging in UNAVAILABLE retries "
+                "until an outer watchdog SIGTERM" if pol.lethal
+                else "signalling the owner")
+        self.log(f"relay tunnel DIED mid-run (ports {dead} closed on "
+                 f"{pol.max_misses} consecutive samples) — {verb}")
+        if self.on_death is not None:
+            try:
+                self.on_death(dead, alive)
+            except Exception as e:  # a broken callback must not mask death
+                self.log(f"deathwatch on_death callback failed: {e}")
+        self.died.set()
+        if not pol.lethal:
+            return
+        if alive:
+            # PARTIAL death: this process may still hold the TPU claim over
+            # a live device port, and an abrupt exit can wedge the server-
+            # side grant for hours (observed live). Attempt a clean PJRT
+            # client close, bounded — the dead port can hang any teardown
+            # RPC, so the attempt is abandoned at its deadline (r5 #3).
+            try_clean_pjrt_close(timeout_s=5.0, log=self.log)
+        hard_exit(pol.exit_code)

@@ -1,0 +1,323 @@
+"""In-process restart supervisor: the epoch loop that survives its faults.
+
+Wraps ``Trainer.train_epoch`` in segments of at most
+``checkpoint_every_steps`` steps. After each segment it writes a
+step-granular checkpoint (manifest-verified by ``training/checkpoint.py``);
+when a segment raises — an injected :class:`~.faults.FaultError`, a real
+step failure, a torn save — it restores the latest *valid* checkpoint and
+replays behind the **step fence**:
+
+* the checkpoint coordinate ``(epoch, step_in_epoch)`` decides where the
+  data iterator resumes (the sampler is deterministic in seed+epoch, so the
+  replayed batches are the exact batches of the lost steps);
+* the restored ``state.step`` drives the per-step RNG fold, so the replayed
+  steps draw the same randomness;
+* the restored int8 error-feedback residuals (``TrainState.grad_sync``)
+  re-enter the telescoping sum where it left off;
+* the fence check ``int(state.step) == epoch * steps_per_epoch + step``
+  catches the double-apply class: a restore whose optimizer step count
+  disagrees with its data coordinate would replay an already-applied
+  update (or skip one) — reported loudly, never silent.
+
+Retries are bounded by :class:`RetryPolicy` (exponential backoff with
+deterministic jitter); preemptions (the ``PreemptionGuard`` flag) are
+DRAINED, not raced: the segment stops at the next step boundary, a
+checkpoint is written, and the supervisor either returns (production: the
+relaunch resumes with ``--resume``) or — in chaos harnesses with
+``resume_preempted=True`` — simulates the relaunch by restoring its own
+checkpoint and continuing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..utils.logging import log_main
+
+
+class SupervisorError(RuntimeError):
+    """The retry budget is exhausted; the last failure is the __cause__."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``max_restarts`` counts restore-and-replay attempts across the whole
+    run (not per fault). Jitter is seeded so chaos runs are reproducible;
+    restart n sleeps ``min(base * factor^(n-1), max) * (1 + jitter * u)``
+    with ``u ~ U[0, 1)`` from the policy's own RNG stream."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 0.25
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+    jitter_frac: float = 0.25
+    seed: int = 0
+
+    def delay_s(self, restart_index: int, rng: random.Random) -> float:
+        base = min(self.backoff_base_s
+                   * self.backoff_factor ** max(0, restart_index - 1),
+                   self.backoff_max_s)
+        return base * (1.0 + self.jitter_frac * rng.random())
+
+
+@dataclasses.dataclass
+class RunReport:
+    """Recovery stats of one supervised run (the chaos CLI's JSON body)."""
+
+    completed: bool = False
+    preempted: bool = False
+    restarts: int = 0
+    preemptions_drained: int = 0
+    steps_run: int = 0        # train steps actually executed, incl. replays
+    steps_replayed: int = 0   # executed more than once (lost to a restore)
+    final_step: int = -1
+    fence_violations: int = 0
+    checkpoints_skipped: int = 0   # torn checkpoints integrity skipped
+    faults_fired: List[str] = dataclasses.field(default_factory=list)
+    faults_unfired: List[str] = dataclasses.field(default_factory=list)
+    failures: List[str] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Supervisor:
+    """Drive ``trainer`` over ``loader`` for N epochs, surviving failures.
+
+    ``state_factory`` must build a FRESH initial TrainState (same seed/
+    structure as the run's): it is both the restore template and the
+    from-scratch fallback — after a failure the in-flight state's buffers
+    may already be donated, so the supervisor never reuses them.
+    ``ckpt`` is a ``training.checkpoint.CheckpointManager`` (or None: no
+    persistence — a failure then restarts from scratch, which is still a
+    correct trajectory, just a long replay). ``injector`` is an armed
+    ``FaultInjector`` or None. ``epoch_end_cb(epoch, state, loss, acc,
+    seconds)`` runs after each COMPLETED epoch (validation / CSV hooks).
+    ``trust_existing=False`` restricts restores to checkpoints THIS run
+    wrote: a fresh (non ``--resume``) run pointed at a directory holding a
+    previous run's checkpoints must never restore one mid-recovery — the
+    highest stale label could place the trajectory past ``epochs`` and the
+    run would "complete" on another run's params (train.py passes
+    ``args.resume``; harnesses with their own directories keep the
+    default).
+    """
+
+    def __init__(self, trainer, ckpt, state_factory: Callable[[], Any],
+                 loader, *, retry: RetryPolicy = RetryPolicy(),
+                 guard=None, injector=None,
+                 checkpoint_every_steps: Optional[int] = None,
+                 resume_preempted: bool = False,
+                 trust_existing: bool = True,
+                 epoch_end_cb: Optional[Callable[..., None]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if checkpoint_every_steps is not None and checkpoint_every_steps <= 0:
+            raise ValueError("checkpoint_every_steps must be positive "
+                             f"(got {checkpoint_every_steps})")
+        self.trainer = trainer
+        self.ckpt = ckpt
+        self.state_factory = state_factory
+        self.loader = loader
+        self.retry = retry
+        self.guard = guard
+        self.injector = injector
+        self.every = checkpoint_every_steps
+        self.resume_preempted = resume_preempted
+        self.trust_existing = trust_existing
+        self.epoch_end_cb = epoch_end_cb
+        self.sleep = sleep
+        self._last_step_entered = -1
+        self._saved_labels: set = set()
+        self._skipped_labels: set = set()
+
+    # -- fence / bookkeeping hooks ----------------------------------------
+
+    def _fault_hook(self, report: RunReport, seg_start_abs: int):
+        """The per-step fence handed to train_epoch: records progress (so a
+        restore can account the replay) and fires injected faults BEFORE
+        the step executes — a crash here means the optimizer never applied
+        this step."""
+        injector = self.injector
+
+        def hook(i: int) -> None:
+            step = seg_start_abs + i
+            self._last_step_entered = step
+            if injector is not None:
+                injector.on_step(step)
+            report.steps_run += 1
+
+        return hook
+
+    def _segment_stop(self, seg_len: int):
+        """stop_fn for one segment: break after seg_len steps, or at the
+        next step boundary once a preemption was requested (the drain)."""
+        count = [0]
+        guard = self.guard
+
+        def stop() -> bool:
+            count[0] += 1
+            if count[0] >= seg_len:
+                return True
+            return bool(guard is not None and guard.should_stop)
+
+        return stop
+
+    # -- checkpoint plumbing ----------------------------------------------
+
+    def _save(self, epoch: int, step: int, spe: int, state) -> None:
+        if self.ckpt is None:
+            return
+        if step >= spe:  # epoch-complete: the epoch-boundary label form
+            label, save_epoch, in_epoch = (epoch + 1) * spe, epoch + 1, 0
+        else:
+            label, save_epoch, in_epoch = epoch * spe + step, epoch, step
+        self.ckpt.save(label, state, wait=True, epoch=save_epoch,
+                       step_in_epoch=in_epoch)
+        self._saved_labels.add(label)
+
+    def _restore_or_fresh(self, report: RunReport, spe: int
+                          ) -> Tuple[Any, int, int]:
+        """Latest VALID checkpoint (torn ones are skipped by the manifest
+        verification), or a fresh state when none exists. Returns
+        ``(state, epoch, step_in_epoch)`` and enforces the step fence."""
+        template = self.state_factory()
+        among = None if self.trust_existing else self._saved_labels
+        restored = (self.ckpt.restore_latest(template, among=among)
+                    if self.ckpt is not None else None)
+        if self.ckpt is not None:
+            # a torn checkpoint is skipped by EVERY later restore; count
+            # distinct labels, not skip events
+            self._skipped_labels.update(self.ckpt.last_skipped)
+            report.checkpoints_skipped = len(self._skipped_labels)
+        if restored is None:
+            if self.ckpt is not None:
+                log_main("supervisor: no valid checkpoint — "
+                         "(re)starting from scratch")
+            return template, 0, 0
+        state, epoch, step = restored
+        expected = epoch * spe + step
+        got = int(state.step)
+        if got != expected:
+            # The double-apply class: optimizer step count disagreeing with
+            # the data coordinate means a replay would re-apply (or skip)
+            # an update. Loud, counted, and resumed at the OPTIMIZER's
+            # position (the authoritative trajectory coordinate).
+            report.fence_violations += 1
+            log_main(f"supervisor: STEP FENCE VIOLATION — restored "
+                     f"optimizer step {got} != checkpoint coordinate "
+                     f"epoch {epoch} * {spe} + step {step} = {expected}; "
+                     "resuming at the optimizer's step to avoid a "
+                     "double-apply")
+            epoch, step = divmod(got, spe)
+        return state, epoch, step
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, epochs: int,
+            initial: Optional[Tuple[Any, int, int]] = None):
+        """Run to completion (or a drained preemption / exhausted retries).
+        ``initial`` is an already-built ``(state, epoch, step)`` start
+        point (train.py's --resume restore); default restores from the
+        manager. Returns ``(final_state, RunReport)``."""
+        spe = len(self.loader)
+        report = RunReport()
+        rng = random.Random(self.retry.seed)
+        if initial is not None:
+            state, epoch, step = initial
+        else:
+            state, epoch, step = self._restore_or_fresh(report, spe)
+
+        while epoch < epochs:
+            seg_start_abs = epoch * spe + step
+            seg_len = (spe - step if self.every is None
+                       else min(self.every, spe - step))
+            try:
+                state, loss, acc, seconds, done = self.trainer.train_epoch(
+                    state, self.loader.epoch(epoch, start_step=step),
+                    epoch, spe, start_step=step,
+                    stop_fn=self._segment_stop(seg_len),
+                    fault_hook=self._fault_hook(report, seg_start_abs))
+                step += done
+                # the save is inside the recovery scope too: "on a
+                # step/SAVE failure, restore the latest valid checkpoint"
+                self._save(epoch, step, spe, state)
+            except Exception as e:  # noqa: BLE001 — every step failure is
+                # a restart candidate; non-restartable ones exhaust the
+                # budget and re-raise as SupervisorError below.
+                if self.guard is not None and self.guard.should_stop:
+                    # A failure DURING the drain window: restarting now
+                    # would race the preemption's hard-exit deadline.
+                    # Leave whatever checkpoint exists; the relaunch
+                    # resumes from it.
+                    report.preempted = True
+                    report.failures.append(
+                        f"{type(e).__name__}: {e} (during preemption drain"
+                        " — not restarted)")
+                    log_main("supervisor: failure during preemption drain; "
+                             "stopping (relaunch resumes from the last "
+                             "checkpoint)")
+                    break
+                report.restarts += 1
+                report.failures.append(f"{type(e).__name__}: {e}")
+                if report.restarts > self.retry.max_restarts:
+                    report.final_step = -1
+                    if self.injector is not None:
+                        report.faults_fired = list(self.injector.fired)
+                        report.faults_unfired = self.injector.unfired()
+                    err = SupervisorError(
+                        f"giving up after {self.retry.max_restarts} "
+                        f"restart(s); last failure: {e}")
+                    err.report = report  # the chaos CLI reports even a loss
+                    raise err from e
+                delay = self.retry.delay_s(report.restarts, rng)
+                log_main(f"supervisor: step failure ({type(e).__name__}: "
+                         f"{e}) — restart {report.restarts}/"
+                         f"{self.retry.max_restarts} in {delay:.2f}s")
+                self.sleep(delay)
+                state, epoch, step = self._restore_or_fresh(report, spe)
+                restored_abs = epoch * spe + step
+                if self._last_step_entered >= 0:
+                    report.steps_replayed += max(
+                        0, self._last_step_entered - restored_abs)
+                continue
+
+            if step >= spe:
+                # epoch complete — BEFORE the drain check: a preemption
+                # landing exactly at the boundary must still emit the
+                # finished epoch's validation/CSV row (the plain loop
+                # does; the supervised path keeps the identical contract)
+                if self.epoch_end_cb is not None:
+                    self.epoch_end_cb(epoch, state, loss, acc, seconds)
+                epoch, step = epoch + 1, 0
+
+            if (self.guard is not None and self.guard.should_stop
+                    and epoch < epochs):
+                # (a preemption landing after the LAST epoch finished has
+                # nothing left to drain — the run is simply complete)
+                report.preemptions_drained += 1
+                if not self.resume_preempted:
+                    report.preempted = True
+                    log_main(f"supervisor: preempted — checkpointed epoch "
+                             f"{epoch} step {step}/{spe}; relaunch with "
+                             "--resume to continue")
+                    break
+                # chaos harness: simulate the relaunch in-process — reset
+                # the guard (disarms its hard-exit deadline) and resume
+                # from the checkpoint just written.
+                log_main("supervisor: preemption drained; simulating "
+                         "relaunch (restore + resume)")
+                self.guard.reset()
+                state, epoch, step = self._restore_or_fresh(report, spe)
+                continue
+        else:
+            report.completed = True
+
+        report.final_step = int(state.step)
+        if self.injector is not None:
+            report.faults_fired = list(self.injector.fired)
+            report.faults_unfired = self.injector.unfired()
+        return state, report

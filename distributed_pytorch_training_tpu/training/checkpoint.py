@@ -2,22 +2,55 @@
 anywhere; SURVEY.md §5 "Checkpoint/resume: Absent") but required for usable
 multi-host training on preemptible TPU pods.
 
-Orbax-backed: sharded async-capable writes, multi-host-safe (every process
-participates; no rank-0 funnel). Only the array pytrees are persisted
+Orbax-backed: sharded writes, multi-host-safe (every process participates;
+no rank-0 funnel). Only the array pytrees are persisted
 (step/params/batch_stats/opt_state/grad_sync); `apply_fn`/`tx` are code,
 reconstructed by the caller — restoring requires a template TrainState with
 matching structure, which `train.py` always has before resume.
+
+Integrity (resilience/): every save writes a per-checkpoint MANIFEST
+(step + a tree digest over the finalized files: path, size, sha256) into
+``<dir>/.manifests/<label>.json``, and ``restore_latest`` verifies the
+manifest before trusting a checkpoint — a torn/truncated checkpoint (disk
+truncation, a partial copy, an injected ``torn_ckpt`` chaos fault) is
+SKIPPED with a loud log and the previous valid one restores instead of the
+run crashing on it. Orbax's own atomic-rename commit already excludes
+interrupted writes from ``all_steps``; the manifest covers the post-commit
+corruption class orbax cannot see. Legacy checkpoints (written before
+manifests existed) have no manifest and restore unverified, exactly as
+before. Because the manifest must hash the FINAL files, ``save`` now always
+finalizes before returning (the ``wait`` flag is kept for API
+compatibility).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 from pathlib import Path
-from typing import Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
+import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from ..utils.logging import log_main
 from .train_state import TrainState
+
+_MANIFEST_DIRNAME = ".manifests"
+_MANIFEST_FORMAT = 1
+
+
+def _file_sha256(path: Path) -> str:
+    # chunked: checkpoint data files are model-sized, and a whole-file
+    # read_bytes() would spike host RAM by the checkpoint size on every
+    # save/verify — on a host already holding params + optimizer state
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 22), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 def _arrays(state: TrainState, epoch: int = 0, step_in_epoch: int = 0) -> dict:
@@ -52,34 +85,162 @@ class CheckpointManager:
 
     `label` orders checkpoints (use epoch * steps_per_epoch + step so
     mid-epoch preemption saves sort between epoch boundaries); the restored
-    (epoch, step_in_epoch) pair tells the caller exactly where to resume."""
+    (epoch, step_in_epoch) pair tells the caller exactly where to resume.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    ``post_save_hook(label, step_dir)`` fires after a save (and its
+    manifest) finalized — the chaos harness's torn-checkpoint injection
+    point (resilience/faults.py). ``last_skipped`` lists the labels the
+    most recent ``restore_latest`` rejected on integrity (the supervisor's
+    recovery report reads it)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 post_save_hook: Optional[Callable[[int, Path], None]]
+                 = None):
+        self._dir = Path(directory).resolve()
         self._mgr = ocp.CheckpointManager(
-            Path(directory).resolve(),
+            self._dir,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True),
         )
+        self._post_save_hook = post_save_hook
+        self.last_skipped: List[int] = []
+        # labels already proven torn (label -> problem): a torn checkpoint
+        # stays torn, so later restores must not re-hash its files to
+        # rediscover it. Cleared per label on re-save.
+        self._known_bad: dict = {}
+
+    # -- manifest plumbing -------------------------------------------------
+
+    def _step_dir(self, label: int) -> Path:
+        return self._dir / str(label)
+
+    def _manifest_path(self, label: int) -> Path:
+        return self._dir / _MANIFEST_DIRNAME / f"{label}.json"
+
+    def _write_manifest(self, label: int, step: int) -> None:
+        step_dir = self._step_dir(label)
+        files = {}
+        tree = hashlib.sha256()
+        for p in sorted(step_dir.rglob("*")):
+            if not p.is_file():
+                continue
+            rel = p.relative_to(step_dir).as_posix()
+            digest = _file_sha256(p)
+            size = p.stat().st_size
+            files[rel] = {"size": size, "sha256": digest}
+            tree.update(f"{rel}\0{size}\0{digest}\0".encode())
+        manifest = {"format": _MANIFEST_FORMAT, "label": label,
+                    "step": int(step), "n_files": len(files),
+                    "tree_digest": tree.hexdigest(), "files": files}
+        path = self._manifest_path(label)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # atomic: a manifest torn by a crash mid-write must read as invalid
+        # (skip), never as a half-truth that validates a half-checkpoint
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(manifest, sort_keys=True))
+        os.replace(tmp, path)
+        # prune manifests of steps orbax's max_to_keep already deleted
+        live = {str(s) for s in self._mgr.all_steps()}
+        for stale in path.parent.glob("*.json"):
+            if stale.stem not in live:
+                stale.unlink(missing_ok=True)
+
+    def verify(self, label: int) -> Optional[str]:
+        """None = intact (or legacy: no manifest to check — restores
+        unverified, exactly as before manifests existed); otherwise a
+        human-readable description of the corruption. Failures are cached
+        per label (torn stays torn) so repeated restores under the restart
+        supervisor don't re-hash the same dead checkpoint."""
+        if label in self._known_bad:
+            return self._known_bad[label]
+        problem = self._verify_uncached(label)
+        if problem is not None:
+            self._known_bad[label] = problem
+        return problem
+
+    def _verify_uncached(self, label: int) -> Optional[str]:
+        path = self._manifest_path(label)
+        if not path.exists():
+            return None  # legacy checkpoint
+        try:
+            manifest = json.loads(path.read_text())
+            files = manifest["files"]
+        except Exception as e:
+            return f"unreadable manifest ({e})"
+        step_dir = self._step_dir(label)
+        for rel, info in files.items():
+            p = step_dir / rel
+            if not p.is_file():
+                return f"file {rel} missing"
+            size = p.stat().st_size
+            if size != info["size"]:
+                return (f"file {rel} truncated ({size} bytes, manifest "
+                        f"says {info['size']})")
+            if _file_sha256(p) != info["sha256"]:
+                return f"file {rel} corrupt (digest mismatch)"
+        return None
+
+    # -- save / restore ----------------------------------------------------
 
     def save(self, label: int, state: TrainState, wait: bool = False,
              epoch: Optional[int] = None, step_in_epoch: int = 0) -> None:
         """`epoch` defaults to `label` (the legacy epoch-granular callers
-        label saves by completed-epoch count)."""
+        label saves by completed-epoch count). Always finalizes before
+        returning (the integrity manifest hashes the final files); `wait`
+        is kept for API compatibility. Re-saving an existing label (the
+        supervisor replaying over a torn save) replaces the whole step."""
+        del wait  # saves are synchronous now — see the module docstring
+        if label in self._mgr.all_steps():
+            # never mix a fresh save into a stale (possibly torn) step dir
+            self._mgr.delete(label)
+            self._manifest_path(label).unlink(missing_ok=True)
+        self._known_bad.pop(label, None)
         self._mgr.save(label, args=ocp.args.StandardSave(
             _arrays(state, label if epoch is None else epoch, step_in_epoch)))
-        if wait:
-            self._mgr.wait_until_finished()
+        self._mgr.wait_until_finished()
+        # manifest writes are process-0-only: every process hashing and
+        # racing the same .manifests/<label>.json.tmp on shared storage
+        # could publish interleaved JSON — an "unreadable manifest" that
+        # makes a GOOD checkpoint skip forever. Verification stays on
+        # every process (read-only; all reach the same verdict).
+        if jax.process_index() == 0:
+            self._write_manifest(label, step=int(state.step))
+        if self._post_save_hook is not None:
+            self._post_save_hook(label, self._step_dir(label))
 
     def restore_latest(
-        self, template: TrainState,
+        self, template: TrainState, among=None,
     ) -> Optional[Tuple[TrainState, int, int]]:
-        """Returns (state, epoch, step_in_epoch) or None if no checkpoint
-        exists. `template` supplies structure/sharding for every restored
-        array. step_in_epoch > 0 means the save was a mid-epoch preemption:
-        resume epoch `epoch` AT that step (the loaders' start_step)."""
-        label = self._mgr.latest_step()
-        if label is None:
-            return None
+        """Returns (state, epoch, step_in_epoch) from the newest checkpoint
+        that PASSES integrity verification, or None if none exists (torn
+        ones are skipped with a loud log — recorded in ``last_skipped``).
+        `template` supplies structure/sharding for every restored array.
+        step_in_epoch > 0 means the save was a mid-epoch preemption:
+        resume epoch `epoch` AT that step (the loaders' start_step).
+        ``among`` (a collection of labels) restricts the candidates — the
+        restart supervisor of a NON-resume run passes the labels it wrote
+        itself, so a stale checkpoint a previous run left in the same
+        directory can never leak into a fresh trajectory."""
+        self.last_skipped = []
+        labels = sorted((label for label in self._mgr.all_steps()
+                         if among is None or label in among), reverse=True)
+        for label in labels:
+            problem = self.verify(label)
+            if problem is not None:
+                log_main(f"CHECKPOINT INTEGRITY: checkpoint {label} is "
+                         f"torn ({problem}) — skipping it and trying the "
+                         "previous one")
+                self.last_skipped.append(label)
+                continue
+            return self._restore(label, template)
+        if self.last_skipped:
+            log_main(f"CHECKPOINT INTEGRITY: every checkpoint "
+                     f"({self.last_skipped}) failed verification — "
+                     "nothing to restore")
+        return None
+
+    def _restore(self, label: int,
+                 template: TrainState) -> Tuple[TrainState, int, int]:
         want = _arrays(template)
         if "grad_sync" in want:
             # An int8-wire template resuming a checkpoint written WITHOUT
@@ -88,7 +249,7 @@ class CheckpointManager:
             # so drop it and let the .get below keep the template's
             # zero-initialized residuals — error feedback restarts its
             # telescope from zero, which is exactly a fresh-start step.
-            meta = self.latest_metadata()
+            meta = self.metadata(label)
             if meta is not None and "grad_sync" not in meta:
                 want.pop("grad_sync")
         restored = self._mgr.restore(
@@ -104,18 +265,23 @@ class CheckpointManager:
         )
         return state, int(restored["epoch"]), int(restored["step_in_epoch"])
 
-    def latest_metadata(self) -> Optional[dict]:
-        """Structure/shape metadata of the latest checkpoint WITHOUT reading
-        array data (orbax item metadata). Lets callers diagnose a template
-        mismatch precisely — e.g. a TP-vocab-padded (50304, d) embedding
-        saved under a different --mesh than the resume run's."""
-        label = self._mgr.latest_step()
+    def metadata(self, label: Optional[int] = None) -> Optional[dict]:
+        """Structure/shape metadata of one checkpoint (default: latest)
+        WITHOUT reading array data (orbax item metadata). Lets callers
+        diagnose a template mismatch precisely — e.g. a TP-vocab-padded
+        (50304, d) embedding saved under a different --mesh than the
+        resume run's."""
+        if label is None:
+            label = self._mgr.latest_step()
         if label is None:
             return None
         try:
             return self._mgr.item_metadata(label)
         except Exception:
             return None
+
+    def latest_metadata(self) -> Optional[dict]:
+        return self.metadata()
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -41,7 +41,7 @@ from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
 from ..parallel.grad_sync import (
     EF_WIRE_DTYPES, WIRE_DTYPES, build_bucket_plan, compressed_psum_scatter,
     ef_state_bucketed, ef_state_zero1, flatten_tree, padded_total_size,
-    reduce_flat, unflatten_tree,
+    quantized_delta_all_gather, reduce_flat, unflatten_tree,
 )
 from ..parallel.mesh import BATCH_AXES, batch_shard_count
 from ..parallel.sharding import (
@@ -94,9 +94,15 @@ class TrainConfig:
     # regardless of the DP degree; see grad_sync.py's accounting). Master
     # accumulation and the optimizer always run fp32. Any non-fp32 value
     # engages the explicit reducer; "bf16"/"int8" compose with zero1 (the
-    # reduce-scatter half compresses via s8 all-to-all, n-independently);
-    # "int8_multihop" + zero1 is rejected (zero1's scatter is already
-    # n-independent — nothing for a second hop to buy).
+    # reduce-scatter half compresses via s8 all-to-all, n-independently).
+    # zero1 + "int8_multihop" is the FULLY compressed zero1 wire: the
+    # scatter half is the s8 all-to-all (already n-independent — same as
+    # "int8", with error feedback), and the param all-gather compresses
+    # too — each replica gathers s8 codes of its shard's UPDATE (new
+    # params - old params) plus one fp32 scale per chunk and adds the
+    # identical dequantized delta to the replicated old params (bounded
+    # per-step error, exactly replica-identical, not fed back;
+    # grad_sync.quantized_delta_all_gather documents the model).
     wire_dtype: str = "fp32"
     # In grad-accum mode, reduce microbatch i's buckets INSIDE the scan
     # body (no data dependency on microbatch i+1's compute, so XLA can
@@ -132,13 +138,6 @@ class Trainer:
         if config.bucket_cap_mb < 0:
             raise ValueError(
                 f"bucket_cap_mb must be >= 0, got {config.bucket_cap_mb}")
-        if config.zero1 and config.wire_dtype == "int8_multihop":
-            raise ValueError(
-                "wire_dtype='int8_multihop' is the bucketed reducer's "
-                "n-independent wire; zero1's reduce-scatter half is ALREADY "
-                "n-independent as an s8 all-to-all — use zero1 with "
-                "wire_dtype='int8' (composing multihop with the zero1 "
-                "gather is a ROADMAP item)")
         if config.zero1 and config.bucket_cap_mb > 0:
             raise ValueError(
                 "bucket_cap_mb is the bucketed reducer of the replicated "
@@ -538,15 +537,26 @@ class Trainer:
         residual per leaf per replica, parallel/grad_sync.py) — the grads
         compress, the parameter all-gather stays exact. The residual is in
         weight-scaled-gradient units (scatter operands are w-scaled sums).
+        "int8_multihop" compresses BOTH halves: the scatter is the same s8
+        all-to-all as "int8" (with error feedback), and the param gather
+        rides s8 too — each replica quantizes its shard's UPDATE (new
+        shard - old shard) per chunk and all replicas add the identical
+        dequantized delta to the replicated old params
+        (grad_sync.quantized_delta_all_gather: bounded per-step error,
+        replica-identical, not fed back — the hop-2 error model).
         """
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
         axes = BATCH_AXES
         task = self.task
         wire = self.config.wire_dtype
-        use_ef = wire == "int8"
+        # multihop's scatter half IS the int8 s8 all-to-all (already
+        # n-independent); what multihop adds over "int8" here is the
+        # compressed param gather below.
+        scatter_wire = "int8" if wire == "int8_multihop" else wire
+        use_ef = wire in EF_WIRE_DTYPES
         if use_ef and not state.grad_sync:
             raise ValueError(
-                "wire_dtype='int8' needs error-feedback buffers — build "
+                f"wire_dtype={wire!r} needs error-feedback buffers — build "
                 "the state via Trainer.init_state (TrainState.grad_sync is "
                 "empty)")
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
@@ -585,7 +595,7 @@ class Trainer:
                 for a, r, acc in zip(g_leaves, ef_leaves, into_leaves):
                     s, nr = compressed_psum_scatter(
                         flatten_pad(a.astype(jnp.float32), n), axes, n,
-                        wire, r)
+                        scatter_wire, r)
                     outs.append(acc + s if combine else s)
                     new_efs.append(nr)
                 return (jax.tree_util.tree_unflatten(treedef, outs),
@@ -667,9 +677,20 @@ class Trainer:
             # 1/N of the optimizer update — the whole point of zero1
             updates, new_opt = outer.tx.update(grads, opt_state, p_shards)
             new_p_shards = optax.apply_updates(p_shards, updates)
-            new_params = jax.tree_util.tree_map(
-                lambda s, p: all_gather(s, axes)[:p.size].reshape(p.shape),
-                new_p_shards, params)
+            if wire == "int8_multihop":
+                # compressed param gather: s8 UPDATE codes + one fp32 scale
+                # per chunk; every replica adds the identical dequantized
+                # delta to the replicated old params, so exact replication
+                # is preserved (grad_sync.quantized_delta_all_gather)
+                new_params = jax.tree_util.tree_map(
+                    lambda s, old, p: quantized_delta_all_gather(
+                        s, old, flatten_pad(p, n), axes,
+                    )[:p.size].reshape(p.shape).astype(p.dtype),
+                    new_p_shards, p_shards, params)
+            else:
+                new_params = jax.tree_util.tree_map(
+                    lambda s, p: all_gather(s, axes)[:p.size].reshape(p.shape),
+                    new_p_shards, params)
 
             if has_stats:
                 # A fully-padded global batch (weight 0) keeps old stats
@@ -731,11 +752,11 @@ class Trainer:
         batch_stats = variables.get("batch_stats", {})
         # int8 gradient wires: zero-initialized error-feedback residuals,
         # attached AFTER mesh placement (they carry their own per-replica
-        # sharding; the rules would replicate them). zero1 feeds back only
-        # under the gather-form "int8" (multihop is rejected at __init__).
-        use_ef = ((self.config.wire_dtype == "int8" and self._zero1)
-                  or (self.config.wire_dtype in EF_WIRE_DTYPES
-                      and self._grad_sync))
+        # sharding; the rules would replicate them). zero1 feeds back on
+        # its scatter half under both int8 forms ("int8_multihop" scatters
+        # via the same s8 all-to-all; only its param gather differs).
+        use_ef = (self.config.wire_dtype in EF_WIRE_DTYPES
+                  and (self._zero1 or self._grad_sync))
         if self._zero1:
             # Params stay replicated (the DDP layout — zero1 shards only
             # the UPDATE); the optimizer state is born flat-padded-sharded
@@ -775,6 +796,7 @@ class Trainer:
         step_hook: Optional[Any] = None,
         start_step: int = 0,
         stop_fn: Optional[Any] = None,
+        fault_hook: Optional[Any] = None,
     ) -> Tuple[TrainState, float, float, float, int]:
         """One epoch (maps train_one_epoch, ref :170-263). Returns
         (state, global mean loss, global top-1 %, epoch wall seconds,
@@ -783,7 +805,12 @@ class Trainer:
         caller hands an already-offset batch iterator; the per-step RNG is
         folded from state.step, so the restored trajectory is identical).
         `stop_fn()` checked after every step: True breaks the loop — the
-        step-granular preemption point (steps executed < full epoch)."""
+        step-granular preemption point (steps executed < full epoch).
+        `fault_hook(step_index)` is the resilience/ step fence: it fires
+        BEFORE the step executes (so a raise there means the optimizer
+        never applied the step — the restart supervisor's restore point)
+        and is None on every un-supervised run (the hot path pays
+        nothing)."""
         cfg = self.config
         epoch_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), epoch)
 
@@ -793,6 +820,8 @@ class Trainer:
         steps_done = 0
 
         for i, batch in enumerate(batches):
+            if fault_hook is not None:
+                fault_hook(i)
             if step_hook is not None:
                 step_hook(i)
             state, metrics = self._train_step(state, batch, epoch_key)

@@ -19,6 +19,7 @@ import signal
 import threading
 from typing import Optional
 
+from ..resilience.heartbeat import hard_exit
 from ..utils.logging import log_main
 
 # Hard deadline for the graceful path. "Stop at the next epoch boundary"
@@ -59,8 +60,12 @@ class PreemptionGuard:
         self._stop = threading.Event()
         self._prev = {}
         self._deadline: Optional[threading.Timer] = None
-        # test seam: replaced to observe the force-exit without dying
-        self._force_exit = lambda: os._exit(143)
+        # test seam: replaced to observe the force-exit without dying.
+        # hard_exit is resilience/heartbeat.py's sanctioned abrupt exit
+        # (the no-bare-os-exit analysis rule bans raw os._exit here): a
+        # zombie that swallowed SIGTERM keeps its device claim, so the
+        # deadline expiry is one of the two legitimate abrupt-exit cases.
+        self._force_exit = lambda: hard_exit(143)
 
     @property
     def should_stop(self) -> bool:

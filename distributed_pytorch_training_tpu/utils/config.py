@@ -141,6 +141,22 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="checkpoint every N epochs")
     parser.add_argument("--resume", action="store_true",
                         help="resume from latest checkpoint in --checkpoint-dir")
+    parser.add_argument("--max-restarts", default=0, type=int,
+                        help="in-process restart supervisor "
+                             "(resilience/supervisor.py): on a step/save "
+                             "failure, restore the latest VALID checkpoint "
+                             "(torn ones are integrity-skipped) and replay "
+                             "behind the step fence, retrying under bounded "
+                             "exponential backoff at most this many times. "
+                             "0 = off. Requires --checkpoint-dir")
+    parser.add_argument("--chaos", default=None, type=str,
+                        help="deterministic fault injection "
+                             "(resilience/faults.py), e.g. 'crash@step=7,"
+                             "sigterm@step=12,torn_ckpt@save=2,"
+                             "loader_stall@step=5:2.5s'. Each fault fires "
+                             "once; compose with --max-restarts to watch "
+                             "the run recover (or without it, to watch it "
+                             "die and --resume)")
     parser.add_argument("--profile-dir", default=None, type=str,
                         help="capture a jax.profiler trace into this directory")
     parser.add_argument("--profile-steps", default="10,20", type=str,

@@ -263,6 +263,50 @@ class TestAxisNameRegistry:
 
 
 # ---------------------------------------------------------------------------
+# no-bare-os-exit
+# ---------------------------------------------------------------------------
+
+
+class TestNoBareOsExit:
+    def test_mutation_every_call_form_flags(self, tmp_path):
+        """A synthetic os._exit in any import form must be caught — abrupt
+        claim-holder death wedges the server-side TPU grant (observed
+        live), so the primitive lives ONLY behind heartbeat.hard_exit."""
+        for src in (
+            "import os\nos._exit(1)\n",
+            "import os as operating\noperating._exit(2)\n",
+            "from os import _exit\n_exit(3)\n",
+            # aliasing is the same hazard with one extra hop
+            "import os\nex = os._exit\n",
+        ):
+            findings = _lint(tmp_path, src, rules=["no-bare-os-exit"])
+            assert _rules_of(findings) == {"no-bare-os-exit"}, src
+
+    def test_heartbeat_home_is_exempt(self, tmp_path):
+        src = "import os\n\ndef hard_exit(code):\n    os._exit(code)\n"
+        findings = _lint(tmp_path, src, rules=["no-bare-os-exit"],
+                         name="resilience/heartbeat.py")
+        assert findings == []
+
+    def test_per_line_suppression_honored(self, tmp_path):
+        src = ("import os\n"
+               "os._exit(70)  # analysis: disable=no-bare-os-exit\n")
+        assert _lint(tmp_path, src, rules=["no-bare-os-exit"]) == []
+
+    def test_docstring_mentions_and_sys_exit_clean(self, tmp_path):
+        src = '''
+            import sys
+
+            def stop():
+                """Docs may say os._exit without tripping the rule."""
+                sys.exit(1)  # a normal exit is not an abrupt one
+
+            comment = "os._exit(70) as a string is prose, not a call"
+        '''
+        assert _lint(tmp_path, src, rules=["no-bare-os-exit"]) == []
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics
 # ---------------------------------------------------------------------------
 

@@ -18,6 +18,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert report["ok"] is True and report["findings"] == []
     statuses = report["contracts"]
     assert set(statuses) == {"dp", "dp_accum", "zero1", "zero1_bf16",
+                             "zero1_int8_mh",
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
                              "gsync_bf16_accum", "gsync_int8_mh",
                              "gsync_int8_mh_accum"}

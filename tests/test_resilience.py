@@ -1,0 +1,557 @@
+"""resilience/: fault-tolerant supervisor, heartbeat, fault injection,
+checkpoint integrity (ISSUE 5 acceptance).
+
+The binding contracts:
+* chaos recovery parity — a run with ``crash@step=k`` under the supervisor
+  resumes from checkpoint and reaches final params BITWISE equal to an
+  uninterrupted same-seed run (fp32, CPU mesh);
+* step fence — a fault between the optimizer update and the checkpoint
+  save does not advance the step counter twice after restore;
+* checkpoint integrity — a truncated checkpoint on disk is skipped with a
+  loud log and the previous valid one restores; legacy (manifest-less)
+  checkpoints still restore.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.resilience.faults import (
+    FaultError, FaultInjector, FaultPlan,
+)
+from distributed_pytorch_training_tpu.resilience.heartbeat import (
+    Deathwatch, LivenessPolicy, port_listening, relay_ports,
+)
+from distributed_pytorch_training_tpu.resilience.supervisor import (
+    RetryPolicy, Supervisor, SupervisorError,
+)
+from distributed_pytorch_training_tpu.training.checkpoint import (
+    CheckpointManager,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# shared rig: one compiled tiny-ResNet trainer for every supervisor test
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rig(mesh8):
+    """(trainer, state_factory, make_loader) — the chaos CLI's own tiny
+    workload (resilience/__main__._build_rig), shared so the compile cost
+    is paid once. `make_loader(fault_hook)` builds a fresh loader over the
+    SAME dataset/seed (identical batch order) per test."""
+    from distributed_pytorch_training_tpu.data.loader import ShardedLoader
+    from distributed_pytorch_training_tpu.resilience.__main__ import (
+        _build_rig,
+    )
+
+    trainer, state_factory, loader = _build_rig(
+        mesh8, seed=0, dataset_size=64, per_device_batch=2)
+    ds = loader.dataset
+
+    def make_loader(fault_hook=None):
+        return ShardedLoader(ds, mesh8, 2, shuffle=True, seed=0,
+                             fault_hook=fault_hook)
+
+    return trainer, state_factory, make_loader
+
+
+def _control_params(trainer, state_factory, loader, epochs):
+    """The uninterrupted same-seed trajectory (no supervisor, no faults)."""
+    state = state_factory()
+    spe = len(loader)
+    for epoch in range(epochs):
+        state, *_ = trainer.train_epoch(state, loader.epoch(epoch), epoch,
+                                        spe)
+    return state
+
+
+def _assert_bitwise_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(x)),
+                                      np.asarray(jax.device_get(y)))
+
+
+_FAST_RETRY = RetryPolicy(max_restarts=4, backoff_base_s=0.01,
+                          backoff_max_s=0.02, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan / FaultInjector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_parse_every_kind(self):
+        plan = FaultPlan.parse("crash@step=7, sigterm@step=12,"
+                               "torn_ckpt@save=2,loader_stall@step=5:2.5s")
+        labels = [f.label() for f in plan.faults]
+        assert labels == ["crash@step=7", "sigterm@step=12",
+                          "torn_ckpt@save=2", "loader_stall@step=5:2.5s"]
+        assert plan.faults[3].seconds == 2.5
+
+    def test_empty_spec_is_empty_plan(self):
+        assert not FaultPlan.parse(None)
+        assert not FaultPlan.parse("")
+
+    def test_parse_rejects_malformed(self):
+        for bad, match in (
+            ("explode@step=1", "unknown chaos fault kind"),
+            ("crash@save=1", "triggers on"),
+            ("torn_ckpt@step=1", "triggers on"),
+            ("crash@step", "not kind@trigger"),
+            ("loader_stall@step=5", "duration"),
+            ("crash@step=5:2s", "no :SECs"),
+        ):
+            with pytest.raises(ValueError, match=match):
+                FaultPlan.parse(bad)
+
+    def test_injector_fires_once_and_reports(self):
+        inj = FaultInjector(FaultPlan.parse("crash@step=3"),
+                            log=lambda _m: None)
+        inj.on_step(2)  # no match
+        with pytest.raises(FaultError, match="crash@step=3"):
+            inj.on_step(3)
+        inj.on_step(3)  # the REPLAY of step 3 after restore must pass
+        assert inj.fired == ["crash@step=3"]
+        assert inj.unfired() == []
+
+    def test_loader_stall_sleeps_once(self):
+        inj = FaultInjector(FaultPlan.parse("loader_stall@step=1:0.15s"),
+                            log=lambda _m: None)
+        t0 = time.monotonic()
+        inj.on_loader_batch(0)
+        assert time.monotonic() - t0 < 0.1
+        inj.on_loader_batch(1)
+        assert time.monotonic() - t0 >= 0.15
+        assert inj.fired == ["loader_stall@step=1:0.15s"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity (manifest + verified restore)
+# ---------------------------------------------------------------------------
+
+
+def _truncate_largest(step_dir: Path) -> Path:
+    files = sorted((p for p in step_dir.rglob("*") if p.is_file()),
+                   key=lambda p: p.stat().st_size, reverse=True)
+    with open(files[0], "r+b") as f:
+        f.truncate(files[0].stat().st_size // 2)
+    return files[0]
+
+
+class TestCheckpointIntegrity:
+    def test_truncated_checkpoint_skipped_loudly(self, rig, tmp_path,
+                                                 capsys):
+        """The acceptance case: tear the NEWEST checkpoint on disk —
+        restore_latest must log loudly, skip it, and restore the previous
+        valid one instead of crashing."""
+        _trainer, state_factory, _ml = rig
+        state = state_factory()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, state, epoch=1)
+        mgr.save(2, state, epoch=2)
+        _truncate_largest(tmp_path / "ckpt" / "2")
+
+        restored = mgr.restore_latest(state_factory())
+        mgr.close()
+        assert restored is not None
+        _state, epoch, step = restored
+        assert (epoch, step) == (1, 0)  # the previous valid one
+        assert mgr.last_skipped == [2]
+        out = capsys.readouterr().out
+        assert "CHECKPOINT INTEGRITY" in out and "truncated" in out
+
+    def test_digest_corruption_detected(self, rig, tmp_path):
+        """Same-size corruption (bit flips) must be caught by the sha256,
+        not just the size check."""
+        _trainer, state_factory, _ml = rig
+        state = state_factory()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, state, epoch=1)
+        mgr.save(2, state, epoch=2)
+        files = sorted(((tmp_path / "ckpt" / "2").rglob("*")),
+                       key=lambda p: p.stat().st_size if p.is_file() else 0,
+                       reverse=True)
+        blob = bytearray(files[0].read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        files[0].write_bytes(bytes(blob))
+        assert "digest mismatch" in mgr.verify(2)
+        restored = mgr.restore_latest(state_factory())
+        mgr.close()
+        assert restored is not None and restored[1] == 1
+
+    def test_legacy_manifestless_checkpoint_restores(self, rig, tmp_path):
+        """Checkpoints written before manifests existed have nothing to
+        verify — they must restore exactly as before (no false skip)."""
+        _trainer, state_factory, _ml = rig
+        state = state_factory()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(3, state, epoch=3)
+        manifest = tmp_path / "ckpt" / ".manifests" / "3.json"
+        assert manifest.exists()
+        manifest.unlink()
+        assert mgr.verify(3) is None  # legacy: nothing to check
+        restored = mgr.restore_latest(state_factory())
+        mgr.close()
+        assert restored is not None and restored[1] == 3
+        assert mgr.last_skipped == []
+
+    def test_all_checkpoints_torn_returns_none(self, rig, tmp_path, capsys):
+        _trainer, state_factory, _ml = rig
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, state_factory(), epoch=1)
+        _truncate_largest(tmp_path / "ckpt" / "1")
+        assert mgr.restore_latest(state_factory()) is None
+        mgr.close()
+        assert "failed verification" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# supervisor: crash recovery, step fence, torn-save recovery, preemption
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisor:
+    def test_crash_recovery_bitwise_parity(self, rig, tmp_path):
+        """ISSUE-5 acceptance: crash@step=5 under the supervisor — the
+        last checkpoint precedes the crash (step 4's update applied but
+        unsaved: the fault sits BETWEEN optimizer update and save), so the
+        supervisor must restore, replay exactly the lost step, and land
+        bitwise where the uninterrupted run lands (fp32, CPU mesh). The
+        final step counter equals the uninterrupted run's — no step
+        double-applied, none skipped."""
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("crash@step=5"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save)
+        sup = Supervisor(trainer, ckpt, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=_FAST_RETRY, injector=inj,
+                         checkpoint_every_steps=2)
+        state, report = sup.run(epochs=2)
+        ckpt.close()
+        assert report.completed and report.restarts == 1
+        assert report.fence_violations == 0
+        assert report.steps_replayed == 1  # step 4 ran twice, nothing else
+        assert report.faults_fired == ["crash@step=5"]
+        assert int(state.step) == 8  # 2 epochs x 4 steps, no double-apply
+
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        assert int(control.step) == 8
+        _assert_bitwise_equal(state.params, control.params)
+        _assert_bitwise_equal(state.batch_stats, control.batch_stats)
+
+    def test_torn_save_skipped_then_bitwise_parity(self, rig, tmp_path):
+        """torn_ckpt@save=2 tears the epoch-0 checkpoint AFTER its manifest
+        was written; the later crash must restore PAST it (integrity skip)
+        to the older valid save, replay the longer gap, and still land
+        bitwise-equal."""
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(
+            FaultPlan.parse("torn_ckpt@save=2,crash@step=5"),
+            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save)
+        sup = Supervisor(trainer, ckpt, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=_FAST_RETRY, injector=inj,
+                         checkpoint_every_steps=2)
+        state, report = sup.run(epochs=2)
+        ckpt.close()
+        assert report.completed and report.restarts == 1
+        assert report.checkpoints_skipped == 1  # the torn save 2 (label 4)
+        assert report.steps_replayed == 3       # restored at 2, crashed at 5
+        assert int(state.step) == 8
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+
+    def test_sigterm_drains_then_resumes_bitwise(self, rig, tmp_path):
+        """sigterm@step=6 goes through the real PreemptionGuard: the
+        segment stops at the next step boundary, checkpoints, and (chaos
+        mode) the simulated relaunch resumes the exact trajectory."""
+        from distributed_pytorch_training_tpu.training.preemption import (
+            PreemptionGuard,
+        )
+
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("sigterm@step=6"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save)
+        guard = PreemptionGuard.install()
+        try:
+            sup = Supervisor(trainer, ckpt, state_factory,
+                             make_loader(inj.on_loader_batch),
+                             retry=_FAST_RETRY, guard=guard, injector=inj,
+                             checkpoint_every_steps=2,
+                             resume_preempted=True)
+            state, report = sup.run(epochs=2)
+        finally:
+            guard.reset()
+            ckpt.close()
+        assert report.completed
+        assert report.preemptions_drained == 1
+        assert report.restarts == 0  # a drain is not a failure
+        assert int(state.step) == 8
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+
+    def test_step_fence_detects_mismatched_coordinate(self, rig, tmp_path):
+        """A checkpoint whose optimizer step disagrees with its (epoch,
+        step) coordinate is the double-apply hazard: the supervisor must
+        flag it and resume at the OPTIMIZER's position."""
+        trainer, state_factory, make_loader = rig
+        state = state_factory()  # step 0
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+        ckpt.save(3, state, epoch=0, step_in_epoch=3)  # lies: claims step 3
+        sup = Supervisor(trainer, ckpt, state_factory, make_loader(),
+                         retry=_FAST_RETRY)
+        from distributed_pytorch_training_tpu.resilience.supervisor import (
+            RunReport,
+        )
+        report = RunReport()
+        _state, epoch, step = sup._restore_or_fresh(report, spe=4)
+        ckpt.close()
+        assert report.fence_violations == 1
+        assert (epoch, step) == (0, 0)  # the optimizer's true position
+
+    def test_gives_up_after_retry_budget(self, rig, tmp_path):
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("crash@step=0,crash@step=1"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+        sup = Supervisor(trainer, ckpt, state_factory, make_loader(),
+                         retry=RetryPolicy(max_restarts=1,
+                                           backoff_base_s=0.01),
+                         injector=inj, checkpoint_every_steps=2)
+        with pytest.raises(SupervisorError, match="giving up"):
+            sup.run(epochs=1)
+        ckpt.close()
+
+    def test_fresh_run_never_restores_stale_checkpoints(self, rig,
+                                                        tmp_path):
+        """trust_existing=False (train.py without --resume): a directory
+        holding a PREVIOUS run's checkpoints must not leak into a fresh
+        trajectory — a crash before the first in-run save restarts from
+        scratch (the stale label, higher than anything this run wrote,
+        would otherwise place the trajectory past `epochs` and the run
+        would 'complete' on another run's params)."""
+        trainer, state_factory, make_loader = rig
+        stale = CheckpointManager(str(tmp_path / "ckpt"))
+        stale.save(8, state_factory(), epoch=2)  # a finished 2-epoch run
+        stale.close()
+
+        inj = FaultInjector(FaultPlan.parse("crash@step=1"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save)
+        sup = Supervisor(trainer, ckpt, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=_FAST_RETRY, injector=inj,
+                         checkpoint_every_steps=2, trust_existing=False)
+        state, report = sup.run(epochs=2,
+                                initial=(state_factory(), 0, 0))
+        ckpt.close()
+        assert report.completed and report.restarts == 1
+        assert int(state.step) == 8  # trained 2 real epochs, not stale
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+
+    def test_loader_stall_is_survived(self, rig, tmp_path):
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("loader_stall@step=1:0.2s"),
+                            log=lambda _m: None)
+        sup = Supervisor(trainer, None, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=_FAST_RETRY, injector=inj)
+        state, report = sup.run(epochs=1)
+        assert report.completed and report.restarts == 0
+        assert report.faults_fired == ["loader_stall@step=1:0.2s"]
+        assert int(state.step) == 4
+
+    def test_retry_policy_backoff_is_bounded_and_jittered(self):
+        import random
+
+        pol = RetryPolicy(max_restarts=10, backoff_base_s=0.5,
+                          backoff_factor=2.0, backoff_max_s=3.0,
+                          jitter_frac=0.5, seed=7)
+        rng = random.Random(pol.seed)
+        delays = [pol.delay_s(i, rng) for i in range(1, 9)]
+        assert all(d >= 0.5 for d in delays)
+        assert all(d <= 3.0 * 1.5 for d in delays)  # cap + max jitter
+        assert delays[3] > delays[0]  # grows before the cap
+        rng2 = random.Random(pol.seed)
+        assert delays == [pol.delay_s(i, rng2)
+                          for i in range(1, 9)]  # deterministic
+
+
+# ---------------------------------------------------------------------------
+# the chaos CLI (the demo IS the harness) + packaging
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_cli_recovers_and_verifies_parity(tmp_path, capsys):
+    """`python -m ...resilience chaos` on a fast plan: recovery stats on
+    stdout, parity verified against the no-fault control run, rc 0."""
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    rc = main(["chaos", "--chaos", "crash@step=2", "--epochs", "1",
+               "--checkpoint-every-steps", "2", "--max-restarts", "2",
+               "--ckpt-dir", str(tmp_path / "ckpt"), "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(out)
+    assert rc == 0
+    assert stats["completed"] is True
+    assert stats["parity_bitwise"] is True
+    assert stats["restarts"] == 1
+    assert stats["faults_fired"] == ["crash@step=2"]
+    assert stats["fence_violations"] == 0
+
+
+@pytest.mark.slow
+def test_chaos_cli_full_default_schedule(tmp_path, capsys):
+    """The full default schedule (crash + torn save + sigterm) across two
+    epochs — the CLI's own acceptance run."""
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    rc = main(["chaos", "--ckpt-dir", str(tmp_path / "ckpt"), "--json"])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert stats["completed"] and stats["parity_bitwise"]
+    assert set(stats["faults_fired"]) == {
+        "crash@step=3", "torn_ckpt@save=2", "sigterm@step=6"}
+    assert stats["faults_unfired"] == []
+
+
+def test_resilience_console_script_declared():
+    """pyproject registers the `resilience` entry point next to `analysis`
+    and it resolves to the CLI main."""
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert ('resilience = "distributed_pytorch_training_tpu.resilience.'
+            '__main__:main"') in pyproject
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+    assert callable(main)
+
+
+# ---------------------------------------------------------------------------
+# heartbeat: the extracted deathwatch
+# ---------------------------------------------------------------------------
+
+
+def _listener():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(8)
+    return s
+
+
+def _accept_forever(s):
+    # a real relay accepts; timeout-polling (not blocking) accept so
+    # close() actually stops the port listening (the bench test's trick)
+    s.settimeout(0.1)
+    while True:
+        try:
+            conn, _ = s.accept()
+            conn.close()
+        except socket.timeout:
+            continue
+        except OSError:
+            return
+
+
+class TestHeartbeat:
+    def test_default_ports_include_8087(self, monkeypatch):
+        """ADVICE r5 #1 pinned: omitting 8087 left the watch blind to an
+        8087-only partial death."""
+        monkeypatch.delenv("DPT_RELAY_PORTS", raising=False)
+        assert relay_ports() == [8082, 8083, 8087]
+        monkeypatch.setenv("DPT_RELAY_PORTS", "9001, bogus,9002")
+        assert relay_ports() == [9001, 9002]
+
+    def test_port_listening_probe(self):
+        srv = _listener()
+        try:
+            assert port_listening(srv.getsockname()[1], timeout=0.5)
+        finally:
+            srv.close()
+        bound = socket.socket()
+        bound.bind(("127.0.0.1", 0))  # bound but NOT listening
+        try:
+            assert not port_listening(bound.getsockname()[1], timeout=0.2)
+        finally:
+            bound.close()
+
+    def test_arm_requires_env_or_confirmation(self, monkeypatch):
+        monkeypatch.delenv("DPT_RELAY_PORTS", raising=False)
+        assert Deathwatch.arm() is None  # no opt-in: heuristics forbidden
+        # opted in but nothing listening: not a tunneled environment
+        bound = socket.socket()
+        bound.bind(("127.0.0.1", 0))
+        try:
+            monkeypatch.setenv("DPT_RELAY_PORTS",
+                               str(bound.getsockname()[1]))
+            assert Deathwatch.arm() is None
+        finally:
+            bound.close()
+
+    def test_advisory_watch_detects_partial_death(self, monkeypatch):
+        """The 1.5s/3-miss lethal semantics, observable: ONE of two armed
+        ports dies (partial death hangs compiles like total death) — the
+        watch must fire, name the dead port, and report the survivor to
+        on_death. lethal=False so the test survives to assert."""
+        srv_dies, srv_stays = _listener(), _listener()
+        for s in (srv_dies, srv_stays):
+            threading.Thread(target=_accept_forever, args=(s,),
+                             daemon=True).start()
+        seen = {}
+        port_dies = srv_dies.getsockname()[1]
+        port_stays = srv_stays.getsockname()[1]
+        monkeypatch.setenv("DPT_RELAY_PORTS", f"{port_dies},{port_stays}")
+        try:
+            watch = Deathwatch.arm(
+                policy=LivenessPolicy(interval_s=0.05,
+                                      connect_timeout_s=0.3, max_misses=3,
+                                      lethal=False),
+                on_death=lambda dead, alive: seen.update(dead=dead,
+                                                         alive=alive),
+                log=lambda _m: None)
+            assert watch is not None and len(watch.armed_ports) == 2
+            time.sleep(0.2)          # a few healthy samples first
+            assert not watch.died.is_set()
+            srv_dies.close()         # the "compile port" dies
+            assert watch.died.wait(timeout=10.0)
+            assert seen["dead"] == [port_dies] == watch.dead_ports
+            assert seen["alive"] == [port_stays]
+        finally:
+            srv_dies.close()
+            srv_stays.close()
+
+    def test_bench_consumes_the_shared_heartbeat(self):
+        """The satellite's anti-drift pin: bench.py's port registry and
+        probe ARE the heartbeat module's (no second copy to rot), and the
+        inlined deathwatch is gone."""
+        sys.path.insert(0, str(REPO))
+        import bench
+
+        assert bench._relay_ports is relay_ports
+        assert bench._port_listening is port_listening
+        src = (REPO / "bench.py").read_text()
+        assert "Deathwatch.arm(" in src
+        # the one-source-of-truth claim, literally: no local def remains
+        assert "def _port_listening" not in src
+        assert "def _relay_ports" not in src
+        assert "def _try_clean_pjrt_close" not in src

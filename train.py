@@ -91,6 +91,12 @@ def main(argv=None):
     args = parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         raise ValueError("--resume requires --checkpoint-dir")
+    if args.max_restarts > 0 and not args.checkpoint_dir:
+        raise ValueError("--max-restarts requires --checkpoint-dir (the "
+                         "supervisor restarts FROM checkpoints)")
+    if args.max_restarts < 0:
+        raise ValueError(f"--max-restarts must be >= 0, got "
+                         f"{args.max_restarts}")
 
     # Preemption guard first: a SIGTERM during data load / compile must also
     # lead to a graceful stop, not a mid-init kill (preemption.py docstring).
@@ -112,7 +118,28 @@ def main(argv=None):
 def _run(args, guard):
     Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
 
+    # Deterministic fault injection (resilience/faults.py): armed ONLY when
+    # --chaos is given — every injection hook below is None otherwise, so
+    # the un-instrumented hot path is untouched.
+    chaos = None
+    if args.chaos:
+        from distributed_pytorch_training_tpu.resilience.faults import (
+            FaultInjector, FaultPlan,
+        )
+        chaos = FaultInjector(FaultPlan.parse(args.chaos), log=log_main)
+        log_main(f"CHAOS: fault plan armed: {args.chaos}")
+
     ctx = setup_distributed()  # ref :318
+    # Relay-tunnel deathwatch (resilience/heartbeat.py, the layer bench.py
+    # seeded): opt-in via DPT_RELAY_PORTS — on the tunneled single-chip
+    # environment a dead relay turns every RPC into an unbounded
+    # UNAVAILABLE retry loop with no client-side remedy, so a training run
+    # there should abort promptly (rc=70) instead of burning its
+    # preemption grace wedged. No-op everywhere else.
+    from distributed_pytorch_training_tpu.resilience.heartbeat import (
+        Deathwatch,
+    )
+    Deathwatch.arm(log=log_main)
     set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
     # Reuse compiles across CLI invocations on accelerators (the TPU analogue
     # of the reference's cudnn.benchmark=True autotune persistence, ref :329).
@@ -313,7 +340,9 @@ def _run(args, guard):
     else:
         train_loader = ShardedLoader(train_ds, mesh, args.batch_size, shuffle=True,
                                      seed=args.seed, drop_last=args.drop_last,
-                                     prefetch=max(2, args.workers // 2))
+                                     prefetch=max(2, args.workers // 2),
+                                     fault_hook=(chaos.on_loader_batch
+                                                 if chaos else None))
         val_loader = ShardedLoader(val_ds, mesh, args.batch_size, shuffle=False,
                                    seed=args.seed, prefetch=2)
         mean, std = IMAGE_STATS[args.dataset.lower()]
@@ -425,7 +454,9 @@ def _run(args, guard):
         from distributed_pytorch_training_tpu.training.checkpoint import (
             CheckpointManager,
         )
-        ckpt = CheckpointManager(args.checkpoint_dir)
+        ckpt = CheckpointManager(
+            args.checkpoint_dir,
+            post_save_hook=chaos.on_save if chaos else None)
         if args.resume:
             try:
                 restored = ckpt.restore_latest(state)
@@ -468,6 +499,58 @@ def _run(args, guard):
 
     csv = MetricsCSV(args.output_dir)  # ref :349-354
 
+    if args.max_restarts > 0:
+        # Restart supervisor (resilience/supervisor.py): segments the epoch
+        # loop, checkpoints every epoch, and on a step/save failure restores
+        # the latest VALID checkpoint and replays behind the step fence.
+        # Validation + the CSV row run per completed epoch via the callback
+        # (identical stdout/CSV contract). --profile-dir and
+        # --checkpoint-every are not threaded through the supervised loop
+        # (it owns the save cadence); preemption drains exactly like the
+        # plain loop: checkpoint + stop, relaunch resumes with --resume.
+        if args.profile_dir:
+            log_main("NOTE: --profile-dir is ignored under --max-restarts")
+        from distributed_pytorch_training_tpu.resilience.supervisor import (
+            RetryPolicy, Supervisor,
+        )
+
+        def state_factory():
+            return trainer.init_state(model, sample_input, tx,
+                                      jax.random.PRNGKey(args.seed))
+
+        def epoch_end(epoch, st, train_loss, train_acc, epoch_time):
+            val_loss, val_acc = trainer.evaluate(st, val_loader.epoch(0))
+            log_main(
+                f"[Epoch {epoch + 1}/{args.epochs}] "
+                f"Train: loss={train_loss:.4f}, acc={train_acc:.2f}% | "
+                f"Val: loss={val_loss:.4f}, acc={val_acc:.2f}% | "
+                f"Epoch time: {epoch_time:.2f}s"
+            )
+            csv.append(epoch, train_loss, train_acc, val_loss, val_acc,
+                       epoch_time)
+
+        # trust_existing=args.resume: a fresh run pointed at a directory
+        # holding a previous run's checkpoints must never restore one
+        # mid-recovery (only --resume opts into the directory's history)
+        sup = Supervisor(trainer, ckpt, state_factory, train_loader,
+                         retry=RetryPolicy(max_restarts=args.max_restarts),
+                         guard=guard, injector=chaos,
+                         trust_existing=args.resume,
+                         epoch_end_cb=epoch_end)
+        state, report = sup.run(args.epochs,
+                                initial=(state, start_epoch, start_step))
+        log_main(f"Supervisor: completed={report.completed} "
+                 f"restarts={report.restarts} "
+                 f"steps_replayed={report.steps_replayed} "
+                 f"torn_checkpoints_skipped={report.checkpoints_skipped}"
+                 + (f" faults_fired={report.faults_fired}"
+                    if report.faults_fired else ""))
+        ckpt.wait()
+        ckpt.close()
+        cleanup_distributed()  # ref :386
+        guard.disarm()
+        return
+
     profiler = None
     if args.profile_dir:
         from distributed_pytorch_training_tpu.utils.profiling import StepProfiler
@@ -484,13 +567,19 @@ def _run(args, guard):
         for epoch in range(start_epoch, args.epochs):  # ref :356
             counts = samples_per_step_list(len(train_ds), global_batch,
                                            steps_per_epoch, args.drop_last)
+            fault_hook = None
+            if chaos is not None:
+                # absolute global-step fence for crash/sigterm injections
+                base = epoch * steps_per_epoch + start_step
+                fault_hook = (lambda i, _base=base: chaos.on_step(_base + i))
             state, train_loss, train_acc, epoch_time, steps_done = \
                 trainer.train_epoch(
                     state, train_loader.epoch(epoch, start_step=start_step),
                     epoch, steps_per_epoch,
                     samples_per_step=counts[start_step:], step_hook=profiler,
                     start_step=start_step,
-                    stop_fn=lambda: guard.should_stop)
+                    stop_fn=lambda: guard.should_stop,
+                    fault_hook=fault_hook)
             abs_step = start_step + steps_done
             start_step = 0
 
