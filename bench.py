@@ -852,6 +852,12 @@ def _bench(args):
         _log(f"bench: {name} done in {r['wall_s']:.1f}s: "
              f"{r['samples_per_sec_chip']:.0f} samples/s/chip, "
              f"mfu={r['mfu_pct']}%, contracts={c_str}")
+        sb = r.get("save_blocked_ms")
+        if sb and "error" not in sb:
+            _log(f"bench: {name} checkpoint stall A/B: sync "
+                 f"{sb['sync_blocked_ms']}ms -> async "
+                 f"{sb['async_blocked_ms']}ms blocked (snapshot "
+                 f"{sb['snapshot_ms']}ms, bg write {sb['write_ms']}ms)")
         if contract is False:
             _log(f"bench: {name} CONTRACT VIOLATIONS: "
                  f"{r['contracts']['violations']}")
@@ -893,8 +899,12 @@ def _bench(args):
     headline = fp32 = None
     if only is None or "headline" in only:
         try:
+            # ckpt_ab: the headline row carries save_blocked_ms — the
+            # sync-vs-async checkpoint stall A/B on the real state (two
+            # throwaway saves; cheap at resnet18 size, and only here so
+            # the big-model arms don't pay double disk writes)
             headline = run("resnet18", per_device_batch=args.batch_size,
-                           steps=args.steps, bf16=True)
+                           steps=args.steps, bf16=True, ckpt_ab=True)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             _log("bench: headline config failed:\n" + traceback.format_exc())

@@ -418,6 +418,53 @@ def check_no_bare_os_exit(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+
+# The one sanctioned home of raw Pallas kernels: the package's ops/
+# directory (flash/ring/ulysses attention, the fused int8 quantize codecs).
+# Matched on exact trailing path components like OS_EXIT_HOME — a future
+# `somewhere_else/ops/` must not inherit the exemption.
+PALLAS_HOME = ("distributed_pytorch_training_tpu", "ops")
+
+_PALLAS_CALL_NAMES = (
+    "jax.experimental.pallas.pallas_call",
+    "jax.experimental.pallas.tpu.pallas_call",
+)
+
+
+@rule("pallas-call-in-ops-only", "ast",
+      "pl.pallas_call appears only under distributed_pytorch_training_tpu/"
+      "ops/",
+      "a Pallas kernel carries per-backend obligations the rest of the "
+      "codebase must not re-derive ad hoc: a TPU gate with an interpreter-"
+      "mode fallback (the XLA-composed path stays the CPU/tier-1 "
+      "reference), a cost estimate, a bit-exactness or tolerance contract "
+      "pinned by tests, and VMEM block-shape rules. ops/ is where those "
+      "conventions live (flash_backend_supported, "
+      "quantize_backend_supported); a pallas_call inlined elsewhere ships "
+      "an ungated kernel that breaks the first time tier-1 runs on CPU.")
+def check_pallas_call_in_ops(ctx: FileContext) -> List[Finding]:
+    parts = tuple(ctx.relpath.replace("\\", "/").split("/"))
+    if parts[-3:-1] == PALLAS_HOME:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        # flag the reference itself (Name or Attribute), not just calls:
+        # `k = pl.pallas_call(...)` via an alias is the same kernel escape
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            resolved = ctx.resolve(node)
+            if resolved in _PALLAS_CALL_NAMES:
+                out.append(Finding(
+                    "pallas-call-in-ops-only",
+                    "pl.pallas_call outside distributed_pytorch_training_"
+                    "tpu/ops/ — raw kernels live in ops/ behind a backend "
+                    "gate + interpreter fallback (the "
+                    "flash_backend_supported convention); export a gated "
+                    "wrapper from ops/ instead",
+                    ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 

@@ -180,6 +180,15 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "multi-hop int8 reducer with in-scan overlapped accumulation",
              config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop",
                          grad_accum=2), min_shards=2),
+    Contract("gsync_int8_mh_fused",
+             "multi-hop int8 wire with the fused Pallas codec kernels "
+             "(ops/quantize.py; interpreter mode on the CPU matrix — the "
+             "kernel path must keep every census/wire/donation promise "
+             "the XLA-composed path keeps, with no relaxation; on TPU "
+             "fused-quantize-kernel-present additionally asserts the "
+             "Mosaic custom-calls really lowered)",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop",
+                         fused_quantize=True), min_shards=2),
 )
 
 

@@ -191,6 +191,11 @@ class StepArtifacts:
     # (path, n_elements) of optimizer-state leaves >= min_elements whose
     # sharding the evaluator found fully replicated (zero1 promises none).
     replicated_state_buffers: Tuple[Tuple[str, int], ...] = ()
+    # the backend the config was lowered FOR ("tpu"/"cpu"/...): rules whose
+    # promise only exists in one backend's lowering (fused-quantize-kernel-
+    # present: Pallas emits a custom-call on TPU but inlines as plain HLO
+    # in CPU interpreter mode) abstain rather than guess when it is "".
+    backend: str = ""
 
     @property
     def wire_mode(self) -> str:
@@ -401,6 +406,81 @@ def check_donation(a: StepArtifacts) -> List[Finding]:
     return []
 
 
+# The Pallas/Mosaic lowering marker on TPU: pallas_call compiles to a
+# custom-call whose target names the Mosaic kernel. CPU interpreter mode
+# inlines the kernel as ordinary HLO — no custom-call exists there, so the
+# rule below only binds on TPU artifacts.
+_PALLAS_CUSTOM_CALL_RE = re.compile(
+    r'custom_call_target="(?:tpu_custom_call|[Mm]osaic[^"]*)"')
+
+# The codec kernels' pallas_call names (ops/quantize.py) — they flow into
+# the custom-call's op_name metadata / Mosaic module name, which is how a
+# quantize custom-call is told apart from any OTHER Pallas kernel in the
+# same step (flash/ring attention lowers to the same tpu_custom_call
+# target; its presence must not vouch for the codec's).
+_QUANTIZE_KERNEL_NAMES = ("fused_quantize_int8_rows",
+                          "fused_dequant_sum_rows")
+
+
+@rule("fused-quantize-kernel-present", "hlo",
+      "a fused_quantize int8 config really lowers Pallas custom-calls",
+      "the fused codec's win is ONE VMEM pass per quantize/dequant stage; "
+      "if the Pallas kernels silently fail to lower (a gate regression, an "
+      "import fallback) the step quietly runs the XLA-composed chain while "
+      "the config claims the kernel path — the same silent-fallback class "
+      "compressed-wire guards for the wire dtype (ops/quantize.py).")
+def check_fused_quantize_kernel(a: StepArtifacts) -> List[Finding]:
+    if a.wire_mode not in ("int8", "int8_multihop"):
+        return []  # no int8 codec in the step — nothing to fuse
+    if not (a.grad_sync_engaged or a.zero1_engaged):
+        return []  # passthrough config: the codec never runs
+    fused = a.config.get("fused_quantize")
+    if fused is None and a.backend == "tpu":
+        # auto (the production default): resolve the tri-state exactly the
+        # way the codec does at trace time — on TPU auto selects the
+        # kernels unless the env override pins them off. Abstaining on
+        # auto would leave the DEFAULT configuration unguarded, the one
+        # place the silent-fallback class this rule exists for ships from.
+        try:
+            from ..ops.quantize import resolve_fused
+            fused = resolve_fused(None)
+        except Exception:  # pragma: no cover - pallas import unavailable
+            fused = False
+    if not fused:
+        return []
+    if a.backend != "tpu":
+        # interpreter mode inlines the kernels as plain HLO ops — there is
+        # no custom-call to assert; the numerics are pinned by the parity
+        # tests instead (tests/test_quantize.py)
+        return []
+    calls = [ln for ln in a.optimized_text.splitlines()
+             if _PALLAS_CUSTOM_CALL_RE.search(ln)]
+    if not calls:
+        return [Finding(
+            "fused-quantize-kernel-present",
+            "fused_quantize=True on an int8 wire, but the optimized HLO "
+            "contains no Pallas/Mosaic custom-call (tpu_custom_call) — "
+            "the fused codec kernels did not lower; the step is running "
+            "the XLA-composed chain while claiming the kernel path",
+            a.name)]
+    if any(name in ln for ln in calls for name in _QUANTIZE_KERNEL_NAMES):
+        return []
+    # Custom-calls exist but none is named as a codec kernel. Only treat
+    # that as a violation when this HLO render demonstrably carries kernel
+    # identity (op_name metadata) on those lines — a metadata-stripped
+    # dump can't distinguish kernels, so presence has to suffice there.
+    if any('op_name="' in ln for ln in calls):
+        return [Finding(
+            "fused-quantize-kernel-present",
+            "fused_quantize=True on an int8 wire: the optimized HLO has "
+            "Pallas/Mosaic custom-calls, but none is a quantize codec "
+            "kernel (fused_quantize_int8_rows / fused_dequant_sum_rows) — "
+            "another Pallas kernel (e.g. flash attention) is masking a "
+            "silent fallback of the codec to the XLA-composed chain",
+            a.name)]
+    return []
+
+
 # Host-transfer markers in optimized HLO: async transfers flagged
 # is_host_transfer, infeed/outfeed ops, and python-callback custom calls
 # (jax.debug.print / pure_callback / io_callback lower to these).
@@ -550,6 +630,7 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         total_grad_bytes=plan.total_bytes,
         min_elements=contract.min_elements,
         replicated_state_buffers=replicated,
+        backend=jax.default_backend(),
     )
 
 

@@ -108,12 +108,17 @@ class TokenLoader:
 
     Same sharding/padding semantics as data.loader.ShardedLoader; token
     masking (MLM) and next-token shifting are device-side task concerns
-    (training/tasks.py), not loader concerns.
+    (training/tasks.py), not loader concerns. ``fault_hook`` is the same
+    resilience/faults.py injection point ShardedLoader carries (the
+    ``loader_stall`` chaos fault — the ROADMAP-carried constraint): called
+    with the in-epoch step index before that step's batch is produced;
+    None on every un-instrumented run, zero hot-path cost.
     """
 
     def __init__(self, dataset: TokenDataset, mesh: Mesh,
                  per_device_batch: int, shuffle: bool, seed: int = 42,
-                 drop_last: bool = False):
+                 drop_last: bool = False, fault_hook=None):
+        self.fault_hook = fault_hook
         self.dataset = dataset
         self.mesh = mesh
         self.global_batch = per_device_batch * batch_shard_count(mesh)
@@ -128,7 +133,10 @@ class TokenLoader:
 
     def epoch(self, epoch: int,
               start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
-        for idx, w in self.sampler.iter_epoch(epoch, start_step):
+        for k, (idx, w) in enumerate(
+                self.sampler.iter_epoch(epoch, start_step)):
+            if self.fault_hook is not None:
+                self.fault_hook(start_step + k)
             yield shard_batch({
                 # native byte-wise row gather (works for int32 rows too)
                 "input_ids": native.gather_rows(self.dataset.tokens, idx),

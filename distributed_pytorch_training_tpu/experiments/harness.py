@@ -20,6 +20,7 @@ differenced time is large enough to trust.
 from __future__ import annotations
 
 import time
+from pathlib import Path
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
@@ -322,6 +323,7 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
             optimized_text=optimized_text,
             preopt_text=preopt,
             config=cfg,
+            backend=jax.default_backend(),
             n_shards=batch_shard_count(trainer.mesh),
             total_grad_bytes=plan.total_bytes,
             replicated_state_buffers=(
@@ -335,6 +337,59 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
         return {"pass": None, "error": f"{type(e).__name__}: {e}"}
 
 
+def checkpoint_save_ab(state, base_dir: Optional[str] = None) -> dict:
+    """Sync-vs-async checkpoint blocked-time A/B on the measured state —
+    the ``save_blocked_ms`` bench instrument (training/checkpoint.py).
+
+    Saves the state once through a synchronous CheckpointManager and once
+    through the async (snapshot-then-write) default, into a throwaway
+    directory, and reports the milliseconds the CALLING thread spent
+    blocked inside ``save`` for each — the step-time stall a training loop
+    pays per save. Under async the blocked time collapses to ~the
+    device→host ``snapshot_ms``; the sync number is the stall the
+    background writer kills. ``write_ms`` is the drained background-write
+    wall (the work that moved OFF the critical path). Best-effort: an I/O
+    failure returns ``{"error": ...}``, never a measurement failure."""
+    import shutil
+    import tempfile
+
+    from ..training.checkpoint import CheckpointManager
+
+    base = Path(tempfile.mkdtemp(prefix="dpt-ckpt-ab-", dir=base_dir))
+    try:
+        out = {}
+        # Discarded warm-up save: the first save in a process pays one-time
+        # orbax/TensorStore costs (driver registry, thread pools) that are
+        # neither arm's steady-state stall — without this they land on
+        # whichever arm runs first and skew the A/B.
+        warm = CheckpointManager(str(base / "warmup"), max_to_keep=1,
+                                 async_save=False)
+        try:
+            warm.save(1, state, epoch=0)
+        finally:
+            warm.close()
+        for mode, async_save in (("sync", False), ("async", True)):
+            mgr = CheckpointManager(str(base / mode), max_to_keep=1,
+                                    async_save=async_save)
+            try:
+                mgr.save(1, state, epoch=0)
+                blocked = mgr.save_blocked_ms
+                t0 = time.perf_counter()
+                mgr.wait()
+                drain_ms = (time.perf_counter() - t0) * 1e3
+                out[f"{mode}_blocked_ms"] = round(blocked, 1)
+                if async_save:
+                    out["snapshot_ms"] = round(mgr.snapshot_ms, 1)
+                    out["write_ms"] = round(drain_ms, 1)
+            finally:
+                mgr.close()
+        return out
+    except Exception as e:  # noqa: BLE001 - observability must not kill a run
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def measure_config(model_name: str, per_device_batch: int, steps: int,
                    bf16: bool, repeats: int = 3, seq_len: int = 512,
                    image_hw: int = 32, num_classes: int = 10,
@@ -342,7 +397,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                    true_fp32: bool = True, min_window_s: float = 0.5,
                    zero1: bool = False,
                    grad_sync: Optional[dict] = None,
-                   comm_trace: bool = False) -> dict:
+                   comm_trace: bool = False,
+                   ckpt_ab: bool = False) -> dict:
     """Full self-verifying measurement of one training config.
 
     Returns a dict with samples/s, FLOPs from XLA cost analysis AND the
@@ -362,6 +418,9 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     across PRs; ``comm_trace=True`` additionally captures a short
     jax.profiler trace and records the exposed-comm fraction
     (``comm_overlap_split``) — best-effort, never a measurement failure.
+    ``ckpt_ab=True`` additionally records ``save_blocked_ms`` — the
+    sync-vs-async checkpoint blocked-time A/B (``checkpoint_save_ab``) on
+    this config's real state.
     """
     import contextlib
 
@@ -421,6 +480,11 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
 
             exposed_comm_pct = trace_exposed_comm(_sacrificial, key=key)
 
+        # checkpoint blocked-time A/B BEFORE the timed windows: the step
+        # donates the state buffers, so after timed_steps this state is
+        # consumed — and the saves must not sit inside a timing window.
+        save_blocked = checkpoint_save_ab(state) if ckpt_ab else None
+
         sps, samples_per_s = timed_steps(compiled, state, batch, global_batch,
                                          steps, repeats,
                                          min_window_s=min_window_s)
@@ -478,6 +542,10 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # collective/wire/donation promises, not just how fast it ran
         "contracts": contracts,
     }
+    if save_blocked is not None:
+        # the async-checkpointing instrument (ISSUE 6): ms the train loop
+        # spends blocked per save, sync vs snapshot-then-write
+        result["save_blocked_ms"] = save_blocked
     if exposed_comm_pct is not None:
         result["exposed_comm_pct"] = exposed_comm_pct
     if is_lm:

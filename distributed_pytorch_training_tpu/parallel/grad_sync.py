@@ -229,25 +229,56 @@ def unflatten_tree(flat: jnp.ndarray, like: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
-def _quantize_int8_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _quantize_int8_rows(rows: jnp.ndarray, fused: Optional[bool] = None
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Row-wise symmetric quantization of a (n, chunk) matrix: one fp32
     max-abs scale PER ROW (= per destination chunk), int8 codes. The single
-    quantization-grid definition every int8 wire shares."""
-    scales = jnp.maximum(jnp.max(jnp.abs(rows), axis=1), 1e-30) / _QMAX
+    quantization-grid definition every int8 wire shares.
+
+    ``fused=None`` resolves via ``ops.quantize.resolve_fused`` (TPU-gated,
+    ``DPT_FUSED_QUANTIZE`` override); True routes through the Pallas fused
+    kernel — BIT-IDENTICAL by contract (PARITY.md), a scheduling change
+    only. The scale is an explicit multiply by 1/127 (not a division):
+    XLA's simplifier rewrites division-by-constant to exactly that inside
+    compiled steps, so writing the multiply keeps this function
+    bit-reproducible across eager/jit/kernel contexts instead of depending
+    on whether the rewrite fired."""
+    from ..ops.quantize import quantize_int8_rows_fused, resolve_fused
+
+    if resolve_fused(fused):
+        return quantize_int8_rows_fused(rows)
+    scales = jnp.maximum(jnp.max(jnp.abs(rows), axis=1), 1e-30) \
+        * (1.0 / _QMAX)
     q = jnp.clip(jnp.round(rows / scales[:, None]),
                  -_QMAX, _QMAX).astype(jnp.int8)
     return q, scales
 
 
-def _quantize_int8(v: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _quantize_int8(v: jnp.ndarray, fused: Optional[bool] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(int8 codes, fp32 scale): symmetric per-bucket max-abs scaling —
     the one-row case of `_quantize_int8_rows`."""
-    q, scales = _quantize_int8_rows(v[None])
+    q, scales = _quantize_int8_rows(v[None], fused=fused)
     return q[0], scales[0]
 
 
+def _dequant_sum_rows(q: jnp.ndarray, scales: jnp.ndarray,
+                      fused: Optional[bool] = None) -> jnp.ndarray:
+    """SUM of dequantized rows — (n, chunk) s8 x (n,) fp32 scales ->
+    (chunk,) fp32: the receive-side accumulate every int8 wire shares
+    (hop-1 partial sums, the zero1 s8 scatter, the gather-form sum).
+    ``fused`` routes through the Pallas kernel (bit-identical contract,
+    ops/quantize.py)."""
+    from ..ops.quantize import dequant_sum_rows_fused, resolve_fused
+
+    if resolve_fused(fused):
+        return dequant_sum_rows_fused(q, scales)
+    return jnp.sum(q.astype(jnp.float32) * scales[:, None], axis=0)
+
+
 def _int8_gather_sum(q: jnp.ndarray, scale: jnp.ndarray,
-                     axis_names: Sequence[str], n_shards: int) -> jnp.ndarray:
+                     axis_names: Sequence[str], n_shards: int,
+                     fused: Optional[bool] = None) -> jnp.ndarray:
     """SUM-of-dequantized across replicas via an s8 all-gather.
 
     Each replica contributes (codes, scale); codes ride the wire as s8
@@ -261,12 +292,13 @@ def _int8_gather_sum(q: jnp.ndarray, scale: jnp.ndarray,
     """
     gathered = lax.all_gather(q, axis_names, axis=0, tiled=True)
     scales = lax.all_gather(scale[None], axis_names, axis=0, tiled=True)
-    per_replica = gathered.reshape(n_shards, -1).astype(jnp.float32)
-    return jnp.sum(per_replica * scales[:, None], axis=0)
+    return _dequant_sum_rows(gathered.reshape(n_shards, -1), scales,
+                             fused=fused)
 
 
 def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
-                       axis_names: Sequence[str], n_shards: int
+                       axis_names: Sequence[str], n_shards: int,
+                       fused: Optional[bool] = None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """DynamiQ-style two-hop compressed SUM of one bucket: s8 all-to-all
     reduce-scatter, local fp32 dequant-sum, requantize, s8 all-gather.
@@ -306,7 +338,7 @@ def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
     chunk = padded // n_shards
     carried = jnp.pad(v, (0, padded - size)) + residual
     rows = carried.reshape(n_shards, chunk)
-    q, scales = _quantize_int8_rows(rows)
+    q, scales = _quantize_int8_rows(rows, fused=fused)
     new_residual = carried - (q.astype(jnp.float32)
                               * scales[:, None]).reshape(-1)
     # hop 1: replica j receives every peer's chunk j (+ the scale each
@@ -315,10 +347,10 @@ def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
                             concat_axis=0, tiled=True)  # (padded,) s8
     recv_scales = lax.all_to_all(scales, names, split_axis=0,
                                  concat_axis=0, tiled=True)  # (n,) fp32
-    partial = jnp.sum(recv_q.reshape(n_shards, chunk).astype(jnp.float32)
-                      * recv_scales[:, None], axis=0)  # (chunk,) fp32
+    partial = _dequant_sum_rows(recv_q.reshape(n_shards, chunk),
+                                recv_scales, fused=fused)  # (chunk,) fp32
     # hop 2: requantize the partial sum, gather codes + scales, dequant
-    q2, scale2 = _quantize_int8(partial)
+    q2, scale2 = _quantize_int8(partial, fused=fused)
     gathered = lax.all_gather(q2, names, axis=0, tiled=True)  # (padded,) s8
     g_scales = lax.all_gather(scale2[None], names, axis=0, tiled=True)
     out = (gathered.reshape(n_shards, chunk).astype(jnp.float32)
@@ -328,7 +360,8 @@ def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
 
 def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
                      n_shards: int, wire_dtype: str,
-                     residual: Optional[jnp.ndarray]
+                     residual: Optional[jnp.ndarray],
+                     fused: Optional[bool] = None
                      ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """One bucket's SUM all-reduce at the chosen wire dtype.
 
@@ -355,14 +388,16 @@ def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
         raise ValueError("int8 wire needs an error-feedback residual "
                          "(Trainer.init_state builds it)")
     carried = v + residual
-    q, scale = _quantize_int8(carried)
+    q, scale = _quantize_int8(carried, fused=fused)
     new_residual = carried - q.astype(jnp.float32) * scale
-    return _int8_gather_sum(q, scale, names, n_shards), new_residual
+    return _int8_gather_sum(q, scale, names, n_shards, fused=fused), \
+        new_residual
 
 
 def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
                 axis_names: Sequence[str], n_shards: int, wire_dtype: str,
-                residual: Optional[jnp.ndarray] = None
+                residual: Optional[jnp.ndarray] = None,
+                fused: Optional[bool] = None
                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Reduce the flat local gradient vector bucket-by-bucket.
 
@@ -384,12 +419,13 @@ def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
         v = lax.slice_in_dim(flat, a, b)
         if multihop:
             r = lax.slice_in_dim(residual, pbounds[k], pbounds[k + 1])
-            summed, new_r = _int8_multihop_sum(v, r, axis_names, n_shards)
+            summed, new_r = _int8_multihop_sum(v, r, axis_names, n_shards,
+                                               fused=fused)
         else:
             r = (lax.slice_in_dim(residual, a, b)
                  if residual is not None else None)
             summed, new_r = _compressed_psum(v, axis_names, n_shards,
-                                             wire_dtype, r)
+                                             wire_dtype, r, fused=fused)
         outs.append(summed)
         if new_r is not None:
             res_outs.append(new_r)
@@ -402,7 +438,8 @@ def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
 def quantized_delta_all_gather(new_shard: jnp.ndarray,
                                old_shard: jnp.ndarray,
                                old_flat: jnp.ndarray,
-                               axis_names: Sequence[str]) -> jnp.ndarray:
+                               axis_names: Sequence[str],
+                               fused: Optional[bool] = None) -> jnp.ndarray:
     """Compressed zero1 PARAM all-gather (the `int8_multihop` composition):
     gather s8 codes of each replica's UPDATE, not fp32 new params.
 
@@ -429,7 +466,7 @@ def quantized_delta_all_gather(new_shard: jnp.ndarray,
     """
     names = tuple(axis_names)
     delta = new_shard - old_shard
-    q, scale = _quantize_int8(delta)
+    q, scale = _quantize_int8(delta, fused=fused)
     gathered = lax.all_gather(q, names, axis=0, tiled=True)  # (padded,) s8
     scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
     n = scales.shape[0]
@@ -440,7 +477,8 @@ def quantized_delta_all_gather(new_shard: jnp.ndarray,
 
 def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
                             n_shards: int, wire_dtype: str,
-                            residual: Optional[jnp.ndarray] = None
+                            residual: Optional[jnp.ndarray] = None,
+                            fused: Optional[bool] = None
                             ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Reduce-scatter one flat-padded leaf at the chosen wire dtype — the
     compressed half-all-reduce of the ZeRO-1 update (training/loop.py).
@@ -475,13 +513,13 @@ def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
         raise ValueError("int8 wire needs an error-feedback residual "
                          "(Trainer.init_state builds it)")
     carried = v + residual
-    q, scale = _quantize_int8(carried)
+    q, scale = _quantize_int8(carried, fused=fused)
     new_residual = carried - q.astype(jnp.float32) * scale
     received = lax.all_to_all(q, names, split_axis=0, concat_axis=0,
                               tiled=True)  # (padded,) s8: peers' chunk j
     scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
-    per_replica = received.reshape(n_shards, -1).astype(jnp.float32)
-    return jnp.sum(per_replica * scales[:, None], axis=0), new_residual
+    return _dequant_sum_rows(received.reshape(n_shards, -1), scales,
+                             fused=fused), new_residual
 
 
 # ---------------------------------------------------------------------------

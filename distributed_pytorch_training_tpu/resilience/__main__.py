@@ -72,7 +72,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("command", choices=["chaos"],
                    help="'chaos' runs the scripted fault schedule")
     p.add_argument("--chaos",
-                   default="crash@step=3,torn_ckpt@save=2,sigterm@step=6",
+                   default="crash@step=3,torn_ckpt@save=2,"
+                           "crash_during_save@save=2,sigterm@step=6",
                    help="fault plan (resilience/faults.py spec)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--per-device-batch", type=int, default=2)
@@ -108,7 +109,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh, args.seed, args.dataset_size, args.per_device_batch,
         fault_hook=injector.on_loader_batch)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="dpt-chaos-")
-    ckpt = CheckpointManager(ckpt_dir, post_save_hook=injector.on_save)
+    # async saves ON (the production default): the schedule's
+    # crash_during_save fault dies on the background writer and must
+    # surface at the next save/wait barrier inside the recovery scope
+    ckpt = CheckpointManager(ckpt_dir, post_save_hook=injector.on_save,
+                             pre_finalize_hook=injector.on_save_finalize)
     guard = PreemptionGuard.install()
     # fast, deterministic backoff: chaos is a harness, not a prod outage
     retry = RetryPolicy(max_restarts=args.max_restarts, backoff_base_s=0.01,
@@ -147,6 +152,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     stats = {"metric": "chaos_recovery", "chaos": args.chaos,
              "epochs": args.epochs, "ckpt_dir": ckpt_dir,
              "parity_bitwise": parity, "error": error,
+             # the async-save instrument: loop-blocked ms vs snapshot ms
+             "save_blocked_ms": round(ckpt.save_blocked_ms, 1),
+             "snapshot_ms": round(ckpt.snapshot_ms, 1),
              **report.as_dict()}
     ok = (report.completed and report.fence_violations == 0
           and parity is not False and error is None)

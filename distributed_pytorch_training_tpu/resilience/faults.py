@@ -13,13 +13,23 @@ points, so every failure a test provokes is reproducible:
   save AFTER it finalized (simulates post-commit corruption: disk
   truncation, a torn copy) so the manifest verification in
   ``training/checkpoint.py`` must catch and skip it.
+* ``crash_during_save@save=3`` — raise :class:`FaultError` INSIDE the 3rd
+  save, between the orbax commit and the manifest write (the
+  ``CheckpointManager(pre_finalize_hook=...)`` window). Under async saves
+  this is the writer-thread crash: the save never finalizes, the error
+  surfaces at the next save/wait barrier, and ``restore_latest`` must
+  skip the half-born checkpoint loudly (the pending marker) instead of
+  trusting it as a legacy one.
 * ``loader_stall@step=5:2.5s`` — sleep 2.5s in the data loader before
   producing the batch of (in-epoch) step 5.
 
 Step indices are the ABSOLUTE global step (``state.step`` before the step
 executes, i.e. steps are 0-indexed from the start of the run) for ``crash``
 and ``sigterm``; ``loader_stall`` uses the in-epoch step index (the loader
-has no global-step view). ``save`` counts finalized saves, 1-indexed.
+has no global-step view). ``save`` counts, 1-indexed: finalized saves for
+``torn_ckpt`` (``on_save``), save ATTEMPTS reaching the finalize window
+for ``crash_during_save`` (``on_save_finalize``) — separate counters, so
+a crashed attempt does not shift the torn schedule.
 
 Every fault fires ONCE: a crash at step k would otherwise re-fire on the
 replay of step k after restore and the run could never make progress.
@@ -49,6 +59,7 @@ FAULT_KINDS = {
     "sigterm": "step",
     "loader_stall": "step",
     "torn_ckpt": "save",
+    "crash_during_save": "save",
 }
 
 _SPEC_RE = re.compile(
@@ -158,6 +169,7 @@ class FaultInjector:
         self._pending: List[Fault] = list(plan.faults)
         self.fired: List[str] = []
         self.saves_seen = 0
+        self.finalizes_seen = 0
         # the hooks fire from different threads (the step fence on the
         # main thread, on_loader_batch from the loader's producer thread)
         # and an unsynchronized take could skip a matching fault — the
@@ -202,3 +214,19 @@ class FaultInjector:
             count = self.saves_seen
         if self._take("torn_ckpt", count) is not None:
             tear_checkpoint(Path(step_dir), log=self.log)
+
+    def on_save_finalize(self, label: int) -> None:
+        """Called by CheckpointManager between the orbax commit and the
+        manifest write (``pre_finalize_hook``) — under async saves, on the
+        writer thread. A ``crash_during_save`` fault raises here: the save
+        dies half-born (committed step, no manifest, pending marker), the
+        torn checkpoint the integrity verification must skip."""
+        with self._lock:
+            self.finalizes_seen += 1
+            count = self.finalizes_seen
+        if self._take("crash_during_save", count) is not None:
+            self.log(f"chaos: injected crash during save {count} "
+                     f"(checkpoint {label}, between orbax commit and "
+                     "manifest)")
+            raise FaultError(f"injected crash_during_save@save={count} "
+                             f"(checkpoint {label})")

@@ -135,13 +135,31 @@ class LivenessPolicy:
     ports must not add up to a kill). ``lethal``: True aborts the process
     with ``exit_code`` (after the bounded PJRT close on partial death);
     False is advisory — the watch sets ``Deathwatch.died`` and stops, and
-    the owner (e.g. a supervisor loop) decides."""
+    the owner (e.g. a supervisor loop) decides. ``escalate_after_s``
+    (advisory watches only): the owner's checkpoint-then-abort depends on
+    the current train step RETURNING, and a dead relay turns device RPCs
+    into unbounded UNAVAILABLE retries — if the process is still alive
+    this many seconds after ``died`` fired, the watch escalates to the
+    lethal abort (bounded PJRT close + ``hard_exit``), so an advisory
+    watch can never hang strictly longer than the lethal one it replaced.
+    None disables escalation."""
 
     interval_s: float = 30.0
     connect_timeout_s: float = 1.5
     max_misses: int = 3
     lethal: bool = True
     exit_code: int = DEATHWATCH_EXIT_CODE
+    escalate_after_s: Optional[float] = None
+
+
+def default_policy(**overrides) -> LivenessPolicy:
+    """The environment-resolved default policy (``WATCH_INTERVAL_ENV``
+    honored), with field overrides — THE way an entry point customizes a
+    watch (e.g. ``default_policy(lethal=False, escalate_after_s=600.0)``)
+    without re-implementing the env resolution."""
+    pol = LivenessPolicy(
+        interval_s=float(os.environ.get(WATCH_INTERVAL_ENV, "30")))
+    return dataclasses.replace(pol, **overrides) if overrides else pol
 
 
 class Deathwatch:
@@ -189,8 +207,7 @@ class Deathwatch:
                 and not assume_tunneled:
             return None
         if policy is None:
-            policy = LivenessPolicy(
-                interval_s=float(os.environ.get(WATCH_INTERVAL_ENV, "30")))
+            policy = default_policy()
         armed = [p for p in relay_ports()
                  if port_listening(p, timeout=policy.connect_timeout_s)]
         if not armed:
@@ -244,7 +261,24 @@ class Deathwatch:
                 self.log(f"deathwatch on_death callback failed: {e}")
         self.died.set()
         if not pol.lethal:
-            return
+            if pol.escalate_after_s is not None:
+                self.log(
+                    f"advisory deathwatch: hard exit rc={pol.exit_code} in "
+                    f"{pol.escalate_after_s:g}s unless the owner's "
+                    "checkpoint-then-abort finishes first")
+                time.sleep(pol.escalate_after_s)
+                # Still here: the owner never terminated — it is wedged in
+                # the unbounded-UNAVAILABLE RPC retries the relay death
+                # causes, and its drain/checkpoint will never run. Fall
+                # through to the lethal abort so advisory mode cannot hang
+                # strictly longer than the lethal watch it replaced.
+                self.log(
+                    f"advisory deathwatch ESCALATING: owner still alive "
+                    f"{pol.escalate_after_s:g}s after relay death — the "
+                    "checkpoint-then-abort is wedged; hard exit "
+                    f"rc={pol.exit_code}")
+            else:
+                return
         if alive:
             # PARTIAL death: this process may still hold the TPU claim over
             # a live device port, and an abrupt exit can wedge the server-

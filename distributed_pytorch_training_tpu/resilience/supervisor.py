@@ -71,6 +71,7 @@ class RunReport:
 
     completed: bool = False
     preempted: bool = False
+    relay_death: bool = False   # advisory deathwatch fired mid-run
     restarts: int = 0
     preemptions_drained: int = 0
     steps_run: int = 0        # train steps actually executed, incl. replays
@@ -105,6 +106,23 @@ class Supervisor:
     run would "complete" on another run's params (train.py passes
     ``args.resume``; harnesses with their own directories keep the
     default).
+
+    ``deathwatch`` is an ADVISORY ``resilience.heartbeat.Deathwatch``
+    (``LivenessPolicy(lethal=False)``) or None: when its ``died`` event
+    sets mid-epoch (the relay tunnel collapsed), the running segment
+    drains at the next step boundary, the segment checkpoint is written
+    and the pending async save FLUSHED, and the run aborts with
+    ``report.relay_death=True`` — checkpoint-then-abort instead of the
+    bare lethal rc=70, so the relaunch resumes instead of replaying the
+    epoch (ROADMAP "resilience follow-ups").
+
+    Async saves: segment checkpoints ride the CheckpointManager's
+    background writer (training continues over the orbax write + manifest
+    hashing); a failed write surfaces at the next save/wait barrier, which
+    is INSIDE the recovery try — "on a step/save failure, restore the
+    latest valid checkpoint" covers the async window too, and the run's
+    final save is flushed before ``run`` declares completion so a lost
+    last save is a recovered failure, not a silent one.
     """
 
     def __init__(self, trainer, ckpt, state_factory: Callable[[], Any],
@@ -114,6 +132,7 @@ class Supervisor:
                  resume_preempted: bool = False,
                  trust_existing: bool = True,
                  epoch_end_cb: Optional[Callable[..., None]] = None,
+                 deathwatch=None,
                  sleep: Callable[[float], None] = time.sleep):
         if checkpoint_every_steps is not None and checkpoint_every_steps <= 0:
             raise ValueError("checkpoint_every_steps must be positive "
@@ -129,6 +148,7 @@ class Supervisor:
         self.resume_preempted = resume_preempted
         self.trust_existing = trust_existing
         self.epoch_end_cb = epoch_end_cb
+        self.deathwatch = deathwatch
         self.sleep = sleep
         self._last_step_entered = -1
         self._saved_labels: set = set()
@@ -154,13 +174,18 @@ class Supervisor:
 
     def _segment_stop(self, seg_len: int):
         """stop_fn for one segment: break after seg_len steps, or at the
-        next step boundary once a preemption was requested (the drain)."""
+        next step boundary once a preemption was requested (the drain) or
+        the advisory deathwatch reported the relay dead (checkpoint-then-
+        abort needs the segment drained first)."""
         count = [0]
         guard = self.guard
+        watch = self.deathwatch
 
         def stop() -> bool:
             count[0] += 1
             if count[0] >= seg_len:
+                return True
+            if watch is not None and watch.died.is_set():
                 return True
             return bool(guard is not None and guard.should_stop)
 
@@ -175,7 +200,11 @@ class Supervisor:
             label, save_epoch, in_epoch = (epoch + 1) * spe, epoch + 1, 0
         else:
             label, save_epoch, in_epoch = epoch * spe + step, epoch, step
-        self.ckpt.save(label, state, wait=True, epoch=save_epoch,
+        # async (snapshot-then-write): only the device→host copy blocks;
+        # the orbax write + manifest overlap the next segment's training.
+        # The manager itself joins any previous in-flight write first, so
+        # an earlier failed save surfaces HERE — inside the recovery try.
+        self.ckpt.save(label, state, epoch=save_epoch,
                        step_in_epoch=in_epoch)
         self._saved_labels.add(label)
 
@@ -245,6 +274,19 @@ class Supervisor:
                 # the save is inside the recovery scope too: "on a
                 # step/SAVE failure, restore the latest valid checkpoint"
                 self._save(epoch, step, spe, state)
+                if self.ckpt is not None and step >= spe:
+                    # Epoch-boundary barrier (the ISSUE-6 design: async
+                    # saves barrier at epoch end): a failed background
+                    # write must surface HERE, inside the recovery scope
+                    # and before epoch_end_cb emits the epoch's
+                    # validation/CSV row — otherwise the failure raises
+                    # one segment late at the next save, the replay
+                    # re-runs the epoch, and the cb fires twice for it
+                    # (duplicate validation + duplicate CSV row). Also
+                    # covers the run's last save: completing with a
+                    # silently lost final checkpoint would not be
+                    # completing.
+                    self.ckpt.wait()
             except Exception as e:  # noqa: BLE001 — every step failure is
                 # a restart candidate; non-restartable ones exhaust the
                 # budget and re-raise as SupervisorError below.
@@ -293,6 +335,43 @@ class Supervisor:
                 if self.epoch_end_cb is not None:
                     self.epoch_end_cb(epoch, state, loss, acc, seconds)
                 epoch, step = epoch + 1, 0
+
+            if (self.deathwatch is not None
+                    and self.deathwatch.died.is_set() and epoch < epochs):
+                # Advisory relay deathwatch: the tunnel died mid-run. The
+                # segment drained at a step boundary and its checkpoint is
+                # already written (possibly still in the async writer) —
+                # FLUSH it, then abort: checkpoint-then-abort instead of
+                # the lethal watch's bare rc=70, so the relaunch resumes
+                # this exact step instead of replaying the epoch.
+                report.relay_death = True
+                if self.ckpt is not None:
+                    try:
+                        self.ckpt.wait()
+                    except Exception as e:  # the pending save was lost —
+                        # re-save synchronously; durable > fast while dying
+                        report.failures.append(
+                            f"{type(e).__name__}: {e} (async save lost "
+                            "during relay-death abort; re-saved)")
+                        try:
+                            self._save(epoch, step, spe, state)
+                            self.ckpt.wait()
+                        except Exception as e2:
+                            # The storage path itself is dying with the
+                            # relay. A raw escape here would lose the
+                            # RunReport AND train.py's rc=70 abort — the
+                            # relaunch replays from the last durable save
+                            # instead, which is exactly what the report
+                            # must say.
+                            report.failures.append(
+                                f"{type(e2).__name__}: {e2} (relay-death "
+                                "re-save ALSO failed; aborting on the "
+                                "last durable checkpoint)")
+                log_main(f"supervisor: relay tunnel died (ports "
+                         f"{getattr(self.deathwatch, 'dead_ports', [])}) — "
+                         f"checkpointed epoch {epoch} step {step}/{spe}; "
+                         "aborting for relaunch")
+                break
 
             if (self.guard is not None and self.guard.should_stop
                     and epoch < epochs):

@@ -18,9 +18,33 @@ run crashing on it. Orbax's own atomic-rename commit already excludes
 interrupted writes from ``all_steps``; the manifest covers the post-commit
 corruption class orbax cannot see. Legacy checkpoints (written before
 manifests existed) have no manifest and restore unverified, exactly as
-before. Because the manifest must hash the FINAL files, ``save`` now always
-finalizes before returning (the ``wait`` flag is kept for API
-compatibility).
+before.
+
+Async saves (snapshot-then-write): ``save`` used to finalize synchronously
+so the manifest could hash final files — the measured step-time stall this
+design kills. Now only the device→host SNAPSHOT happens on the caller's
+thread (it must: the train step donates the state buffers, so deferring the
+copy would read freed memory), and the orbax write + chunked-sha256 manifest
+run on ONE background writer while training continues. Barriers:
+
+* the next ``save`` joins the previous write first (at most one write in
+  flight — also where a failed async write surfaces, as the raised error);
+* ``wait()`` / ``close()`` at shutdown, and every restore/metadata read,
+  join the writer before touching the directory.
+
+The async window does NOT widen the torn-checkpoint window silently: a
+PENDING marker (``.manifests/<label>.pending``) is written before the
+background write starts and removed only after the manifest finalizes, so a
+crash between the orbax commit and the manifest leaves a checkpoint that
+``verify`` reports as torn ("never finalized") instead of one that
+masquerades as a trusted legacy checkpoint. What async changes is *when*
+bytes hit disk, never *what*: the written files and manifest digests are
+those of a synchronous save of the same state (PARITY.md).
+
+Blocked-time accounting (the bench instrument): ``save_blocked_ms`` sums
+every millisecond the calling thread spent inside ``save``/``wait`` —
+under async saves it collapses to ~``snapshot_ms`` (the device→host copy),
+which is the whole point.
 """
 
 from __future__ import annotations
@@ -28,6 +52,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
+import time
 from pathlib import Path
 from typing import Callable, List, Optional, Tuple
 
@@ -87,15 +113,29 @@ class CheckpointManager:
     mid-epoch preemption saves sort between epoch boundaries); the restored
     (epoch, step_in_epoch) pair tells the caller exactly where to resume.
 
+    ``async_save=True`` (the default) makes ``save`` snapshot-then-write:
+    device→host copy on the caller's thread, orbax write + manifest on a
+    background writer (``save(..., wait=True)`` forces one save back to
+    synchronous — the preemption-drain saves use it: the process is about
+    to exit, overlap buys nothing). A failed background write re-raises
+    from the NEXT ``save``/``wait`` call — inside the supervisor's
+    recovery scope, so "on a step/save failure, restore the latest valid
+    checkpoint" covers async saves too.
+
     ``post_save_hook(label, step_dir)`` fires after a save (and its
     manifest) finalized — the chaos harness's torn-checkpoint injection
-    point (resilience/faults.py). ``last_skipped`` lists the labels the
-    most recent ``restore_latest`` rejected on integrity (the supervisor's
-    recovery report reads it)."""
+    point (resilience/faults.py). ``pre_finalize_hook(label)`` fires
+    between the orbax commit and the manifest write — the
+    ``crash_during_save`` injection point (a raise there aborts the save
+    exactly inside the async window the pending marker guards).
+    ``last_skipped`` lists the labels the most recent ``restore_latest``
+    rejected on integrity (the supervisor's recovery report reads it)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  post_save_hook: Optional[Callable[[int, Path], None]]
-                 = None):
+                 = None,
+                 async_save: bool = True,
+                 pre_finalize_hook: Optional[Callable[[int], None]] = None):
         self._dir = Path(directory).resolve()
         self._mgr = ocp.CheckpointManager(
             self._dir,
@@ -103,11 +143,23 @@ class CheckpointManager:
                 max_to_keep=max_to_keep, create=True),
         )
         self._post_save_hook = post_save_hook
+        self._pre_finalize_hook = pre_finalize_hook
+        self._async = bool(async_save)
         self.last_skipped: List[int] = []
         # labels already proven torn (label -> problem): a torn checkpoint
         # stays torn, so later restores must not re-hash its files to
         # rediscover it. Cleared per label on re-save.
         self._known_bad: dict = {}
+        # the one in-flight background write (at most one: the next save
+        # joins it first, so orbax manager state is never touched from two
+        # threads at once) and its failure, surfaced at the next barrier
+        self._writer: Optional[threading.Thread] = None
+        self._writer_label: Optional[int] = None
+        self._writer_error: Optional[BaseException] = None
+        # blocked-time accounting (bench: the save_blocked_ms instrument)
+        self.save_blocked_ms = 0.0   # caller-thread ms inside save()/wait()
+        self.snapshot_ms = 0.0       # of which: the device→host snapshot
+        self.saves_started = 0
 
     # -- manifest plumbing -------------------------------------------------
 
@@ -116,6 +168,9 @@ class CheckpointManager:
 
     def _manifest_path(self, label: int) -> Path:
         return self._dir / _MANIFEST_DIRNAME / f"{label}.json"
+
+    def _pending_path(self, label: int) -> Path:
+        return self._dir / _MANIFEST_DIRNAME / f"{label}.pending"
 
     def _write_manifest(self, label: int, step: int) -> None:
         step_dir = self._step_dir(label)
@@ -139,18 +194,22 @@ class CheckpointManager:
         tmp = path.with_suffix(".json.tmp")
         tmp.write_text(json.dumps(manifest, sort_keys=True))
         os.replace(tmp, path)
-        # prune manifests of steps orbax's max_to_keep already deleted
+        # prune manifests (and pending markers) of steps orbax's
+        # max_to_keep already deleted
         live = {str(s) for s in self._mgr.all_steps()}
-        for stale in path.parent.glob("*.json"):
+        for stale in list(path.parent.glob("*.json")) \
+                + list(path.parent.glob("*.pending")):
             if stale.stem not in live:
                 stale.unlink(missing_ok=True)
 
     def verify(self, label: int) -> Optional[str]:
         """None = intact (or legacy: no manifest to check — restores
         unverified, exactly as before manifests existed); otherwise a
-        human-readable description of the corruption. Failures are cached
-        per label (torn stays torn) so repeated restores under the restart
-        supervisor don't re-hash the same dead checkpoint."""
+        human-readable description of the corruption. An orbax-committed
+        step whose PENDING marker survives without a manifest is an async
+        save that died before finalizing — torn, never legacy. Failures
+        are cached per label (torn stays torn) so repeated restores under
+        the restart supervisor don't re-hash the same dead checkpoint."""
         if label in self._known_bad:
             return self._known_bad[label]
         problem = self._verify_uncached(label)
@@ -161,6 +220,15 @@ class CheckpointManager:
     def _verify_uncached(self, label: int) -> Optional[str]:
         path = self._manifest_path(label)
         if not path.exists():
+            if self._pending_path(label).exists():
+                # the async writer started this save and never finalized it
+                # (crash between the orbax commit and the manifest write) —
+                # the files may even be complete, but nothing vouches for
+                # them; treating it as legacy would silently WIDEN the
+                # torn-checkpoint window by exactly the async interval
+                return ("async save never finalized (pending marker "
+                        "present, no manifest — the writer died between "
+                        "the orbax commit and the manifest)")
             return None  # legacy checkpoint
         try:
             manifest = json.loads(path.read_text())
@@ -180,33 +248,102 @@ class CheckpointManager:
                 return f"file {rel} corrupt (digest mismatch)"
         return None
 
-    # -- save / restore ----------------------------------------------------
+    # -- the background writer ---------------------------------------------
 
-    def save(self, label: int, state: TrainState, wait: bool = False,
-             epoch: Optional[int] = None, step_in_epoch: int = 0) -> None:
-        """`epoch` defaults to `label` (the legacy epoch-granular callers
-        label saves by completed-epoch count). Always finalizes before
-        returning (the integrity manifest hashes the final files); `wait`
-        is kept for API compatibility. Re-saving an existing label (the
-        supervisor replaying over a torn save) replaces the whole step."""
-        del wait  # saves are synchronous now — see the module docstring
-        if label in self._mgr.all_steps():
-            # never mix a fresh save into a stale (possibly torn) step dir
-            self._mgr.delete(label)
-            self._manifest_path(label).unlink(missing_ok=True)
-        self._known_bad.pop(label, None)
-        self._mgr.save(label, args=ocp.args.StandardSave(
-            _arrays(state, label if epoch is None else epoch, step_in_epoch)))
+    def _join_writer(self, reraise: bool = True) -> None:
+        """Barrier on the in-flight write. ``reraise=True`` (save/wait)
+        surfaces a failed write as the raised error — inside the
+        supervisor's recovery scope; ``reraise=False`` (restore/metadata/
+        close paths) logs it instead: a failed save is a torn/absent
+        checkpoint, which the integrity verification already handles."""
+        t = self._writer
+        if t is not None:
+            t.join()
+            self._writer = None
+        err, label = self._writer_error, self._writer_label
+        if err is None:
+            return
+        self._writer_error = None
+        self._writer_label = None
+        if reraise:
+            raise err
+        log_main(f"CHECKPOINT: async save of checkpoint {label} failed "
+                 f"({type(err).__name__}: {err}) — it will be skipped by "
+                 "integrity verification")
+
+    def _write_job(self, label: int, snapshot: dict, step_value: int) -> None:
+        """Everything after the snapshot: orbax write + finalize, the
+        manifest, the pending-marker removal, and the hooks. Runs on the
+        writer thread (async) or inline (sync / ``wait=True``)."""
+        self._mgr.save(label, args=ocp.args.StandardSave(snapshot))
         self._mgr.wait_until_finished()
+        if self._pre_finalize_hook is not None:
+            # the crash_during_save window: orbax has committed, the
+            # manifest does not exist yet — a raise here must leave a
+            # checkpoint restore_latest skips loudly (the pending marker)
+            self._pre_finalize_hook(label)
         # manifest writes are process-0-only: every process hashing and
         # racing the same .manifests/<label>.json.tmp on shared storage
         # could publish interleaved JSON — an "unreadable manifest" that
         # makes a GOOD checkpoint skip forever. Verification stays on
         # every process (read-only; all reach the same verdict).
         if jax.process_index() == 0:
-            self._write_manifest(label, step=int(state.step))
+            self._write_manifest(label, step=step_value)
+            self._pending_path(label).unlink(missing_ok=True)
         if self._post_save_hook is not None:
             self._post_save_hook(label, self._step_dir(label))
+
+    def _writer_main(self, label: int, snapshot: dict,
+                     step_value: int) -> None:
+        try:
+            self._write_job(label, snapshot, step_value)
+        except BaseException as e:  # surfaced at the next barrier
+            self._writer_error = e
+            self._writer_label = label
+
+    # -- save / restore ----------------------------------------------------
+
+    def save(self, label: int, state: TrainState, wait: bool = False,
+             epoch: Optional[int] = None, step_in_epoch: int = 0) -> None:
+        """`epoch` defaults to `label` (the legacy epoch-granular callers
+        label saves by completed-epoch count). Snapshot-then-write: the
+        device→host copy happens HERE (the train step donates these
+        buffers — deferring the read would race the donation), then the
+        orbax write + manifest run on the background writer unless
+        ``wait=True`` or the manager was built ``async_save=False``.
+        Joins (and surfaces the failure of) any previous in-flight write
+        first. Re-saving an existing label (the supervisor replaying over
+        a torn save) replaces the whole step."""
+        t0 = time.perf_counter()
+        self._join_writer()
+        if label in self._mgr.all_steps():
+            # never mix a fresh save into a stale (possibly torn) step dir
+            self._mgr.delete(label)
+            self._manifest_path(label).unlink(missing_ok=True)
+        self._known_bad.pop(label, None)
+        t_snap = time.perf_counter()
+        # the only device work of a save: one host copy of the arrays.
+        # numpy leaves land in orbax exactly like device arrays do, so the
+        # written bytes (and manifest digests) match a synchronous save.
+        snapshot = jax.device_get(_arrays(
+            state, label if epoch is None else epoch, step_in_epoch))
+        step_value = int(snapshot["step"])
+        self.snapshot_ms += (time.perf_counter() - t_snap) * 1e3
+        self.saves_started += 1
+        if jax.process_index() == 0:
+            pending = self._pending_path(label)
+            pending.parent.mkdir(parents=True, exist_ok=True)
+            pending.write_text(json.dumps(
+                {"label": label, "step": step_value}))
+        if self._async and not wait:
+            t = threading.Thread(
+                target=self._writer_main, args=(label, snapshot, step_value),
+                name=f"ckpt-writer-{label}", daemon=True)
+            self._writer = t
+            t.start()
+        else:
+            self._write_job(label, snapshot, step_value)
+        self.save_blocked_ms += (time.perf_counter() - t0) * 1e3
 
     def restore_latest(
         self, template: TrainState, among=None,
@@ -220,7 +357,11 @@ class CheckpointManager:
         ``among`` (a collection of labels) restricts the candidates — the
         restart supervisor of a NON-resume run passes the labels it wrote
         itself, so a stale checkpoint a previous run left in the same
-        directory can never leak into a fresh trajectory."""
+        directory can never leak into a fresh trajectory. Any in-flight
+        async write is joined first (a restore must never race the
+        writer); its failure, if any, is logged, not raised — a failed
+        save is exactly a torn checkpoint, handled below."""
+        self._join_writer(reraise=False)
         self.last_skipped = []
         labels = sorted((label for label in self._mgr.all_steps()
                          if among is None or label in among), reverse=True)
@@ -271,6 +412,7 @@ class CheckpointManager:
         diagnose a template mismatch precisely — e.g. a TP-vocab-padded
         (50304, d) embedding saved under a different --mesh than the
         resume run's."""
+        self._join_writer(reraise=False)
         if label is None:
             label = self._mgr.latest_step()
         if label is None:
@@ -284,7 +426,15 @@ class CheckpointManager:
         return self.metadata()
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        """Barrier: join the background writer (re-raising its failure —
+        a shutdown must not silently drop a lost save) and drain orbax."""
+        t0 = time.perf_counter()
+        try:
+            self._join_writer()
+            self._mgr.wait_until_finished()
+        finally:
+            self.save_blocked_ms += (time.perf_counter() - t0) * 1e3
 
     def close(self) -> None:
+        self._join_writer(reraise=False)
         self._mgr.close()

@@ -110,6 +110,15 @@ class TrainConfig:
     # accumulate locally and reduce once after the scan (exposes the comm;
     # exists to measure the overlap win).
     overlap_grad_sync: bool = True
+    # Fused int8 codec kernels (ops/quantize.py): route the int8 wires'
+    # quantize (absmax-scale + round/clip) and receive-side dequant-
+    # accumulate through Pallas kernels instead of the XLA-composed op
+    # chain — one VMEM pass per codec stage, bit-identical by contract
+    # (PARITY.md). None = auto (TPU only, DPT_FUSED_QUANTIZE env
+    # override); True forces the kernels (interpreter mode on CPU — the
+    # parity-test configuration); False forces the XLA-composed reference.
+    # A no-op unless wire_dtype is an int8 mode on a multi-shard mesh.
+    fused_quantize: Optional[bool] = None
 
 
 class Trainer:
@@ -354,6 +363,7 @@ class Trainer:
         axes = BATCH_AXES
         task, cfg = self.task, self.config
         wire, overlap = cfg.wire_dtype, cfg.overlap_grad_sync
+        fusedq = cfg.fused_quantize  # tri-state; codecs resolve at trace
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
         outer = state
         plan = build_bucket_plan(state.params, cfg.bucket_cap_mb)
@@ -407,7 +417,8 @@ class Trainer:
                 w = m["weight"]
                 flat = flatten_tree(jax.tree_util.tree_map(
                     lambda a: w * a.astype(jnp.float32), g))
-                flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l)
+                flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l,
+                                         fused=fusedq)
                 s_sum = (jax.tree_util.tree_map(
                     lambda s: w * s.astype(jnp.float32), stats_l)
                     if has_stats else stats)
@@ -442,7 +453,7 @@ class Trainer:
                         # holds already-global sums, and the collective
                         # overlaps the next microbatch's compute
                         flat, ef_c = reduce_flat(flat, plan, axes, n,
-                                                 wire, ef_c)
+                                                 wire, ef_c, fused=fusedq)
                     acc = acc + flat
                     if has_stats:
                         s_sum = jax.tree_util.tree_map(
@@ -458,7 +469,8 @@ class Trainer:
                     mb_body, (acc0, s0, zero_metrics(), ef_l),
                     (micro_batches, keys))
                 if not overlap:
-                    flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l)
+                    flat, ef_l = reduce_flat(flat, plan, axes, n, wire,
+                                             ef_l, fused=fusedq)
 
             # metric fan-in (the zero1 comment verbatim: 3 scalar psums)
             metrics = jax.tree_util.tree_map(
@@ -549,6 +561,7 @@ class Trainer:
         axes = BATCH_AXES
         task = self.task
         wire = self.config.wire_dtype
+        fusedq = self.config.fused_quantize  # tri-state, resolved at trace
         # multihop's scatter half IS the int8 s8 all-to-all (already
         # n-independent); what multihop adds over "int8" here is the
         # compressed param gather below.
@@ -595,7 +608,7 @@ class Trainer:
                 for a, r, acc in zip(g_leaves, ef_leaves, into_leaves):
                     s, nr = compressed_psum_scatter(
                         flatten_pad(a.astype(jnp.float32), n), axes, n,
-                        scatter_wire, r)
+                        scatter_wire, r, fused=fusedq)
                     outs.append(acc + s if combine else s)
                     new_efs.append(nr)
                 return (jax.tree_util.tree_unflatten(treedef, outs),
@@ -684,7 +697,7 @@ class Trainer:
                 # is preserved (grad_sync.quantized_delta_all_gather)
                 new_params = jax.tree_util.tree_map(
                     lambda s, old, p: quantized_delta_all_gather(
-                        s, old, flatten_pad(p, n), axes,
+                        s, old, flatten_pad(p, n), axes, fused=fusedq,
                     )[:p.size].reshape(p.shape).astype(p.dtype),
                     new_p_shards, p_shards, params)
             else:

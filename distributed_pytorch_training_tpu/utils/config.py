@@ -107,6 +107,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "bf16/int8 compose with --zero1 (the reduce-"
                              "scatter half compresses, n-independently); "
                              "int8_multihop + --zero1 is rejected")
+    parser.add_argument("--fused-quantize", default="auto", type=str,
+                        choices=["auto", "on", "off"],
+                        help="fused Pallas int8 codec kernels "
+                             "(ops/quantize.py) for the int8 wire dtypes: "
+                             "quantize (absmax-scale+round/clip) and "
+                             "receive-side dequant-accumulate run as one "
+                             "VMEM pass each instead of XLA's composed op "
+                             "chain — bit-identical by contract "
+                             "(PARITY.md). auto = TPU only (CPU keeps the "
+                             "XLA-composed reference; DPT_FUSED_QUANTIZE "
+                             "env overrides); on forces the kernels "
+                             "(interpreter mode on CPU — for parity "
+                             "tests/A-Bs); off forces the XLA path")
     parser.add_argument("--no-overlap-grad-sync", action="store_true",
                         help="with --bucket-cap-mb and --grad-accum > 1: "
                              "reduce buckets once after the microbatch "

@@ -307,6 +307,69 @@ class TestNoBareOsExit:
 
 
 # ---------------------------------------------------------------------------
+# pallas-call-in-ops-only
+# ---------------------------------------------------------------------------
+
+
+class TestPallasCallInOpsOnly:
+    def test_mutation_every_import_form_flags(self, tmp_path):
+        """A raw pl.pallas_call outside ops/ ships an ungated kernel (no
+        backend gate, no interpreter fallback) — every import form must be
+        caught (ISSUE 6 satellite)."""
+        for src in (
+            "from jax.experimental import pallas as pl\n"
+            "k = pl.pallas_call(None, out_shape=None)\n",
+            "from jax.experimental.pallas import pallas_call\n"
+            "k = pallas_call(None, out_shape=None)\n",
+            "import jax.experimental.pallas as pl\n"
+            "k = pl.pallas_call\n",  # aliasing: same escape, one extra hop
+        ):
+            findings = _lint(tmp_path, src,
+                             rules=["pallas-call-in-ops-only"])
+            assert _rules_of(findings) == {"pallas-call-in-ops-only"}, src
+
+    def test_ops_home_is_exempt(self, tmp_path):
+        src = ("from jax.experimental import pallas as pl\n"
+               "k = pl.pallas_call(None, out_shape=None)\n")
+        findings = _lint(
+            tmp_path, src, rules=["pallas-call-in-ops-only"],
+            name="distributed_pytorch_training_tpu/ops/mykernel.py")
+        assert findings == []
+
+    def test_lookalike_ops_dir_not_exempt(self, tmp_path):
+        """Exact trailing-component match (the OS_EXIT_HOME convention): a
+        future `somewhere_else/ops/` must not inherit the exemption."""
+        src = ("from jax.experimental import pallas as pl\n"
+               "k = pl.pallas_call(None, out_shape=None)\n")
+        findings = _lint(tmp_path, src, rules=["pallas-call-in-ops-only"],
+                         name="serving/ops/rogue.py")
+        assert _rules_of(findings) == {"pallas-call-in-ops-only"}
+
+    def test_docstring_mentions_and_suppression_clean(self, tmp_path):
+        src = '''
+            """Prose about pl.pallas_call is not a kernel escape."""
+            from jax.experimental import pallas as pl
+
+            grid = pl.BlockSpec  # other pallas APIs are not the kernel
+            MSG = "wrap pl.pallas_call in ops/ behind a gate"
+        '''
+        assert _lint(tmp_path, src,
+                     rules=["pallas-call-in-ops-only"]) == []
+        suppressed = (
+            "from jax.experimental import pallas as pl\n"
+            "k = pl.pallas_call  "
+            "# analysis: disable=pallas-call-in-ops-only\n")
+        assert _lint(tmp_path, suppressed,
+                     rules=["pallas-call-in-ops-only"]) == []
+
+    def test_repo_ops_kernels_are_the_only_users(self):
+        """The rule binds on the real tree: every pallas_call in the repo
+        lives under the package's ops/ (flash/ring/ulysses attention, the
+        fused quantize codecs)."""
+        assert run_ast_rules(rules=["pallas-call-in-ops-only"]) == []
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics
 # ---------------------------------------------------------------------------
 

@@ -21,7 +21,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "zero1_int8_mh",
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
                              "gsync_bf16_accum", "gsync_int8_mh",
-                             "gsync_int8_mh_accum"}
+                             "gsync_int8_mh_accum", "gsync_int8_mh_fused"}
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran
     kinds = {r for r in report["rules_run"]}

@@ -277,6 +277,103 @@ class TestHostTransferRule:
                     "no-host-transfer") == []
 
 
+class TestFusedQuantizeKernelRule:
+    """fused-quantize-kernel-present (ISSUE 6 satellite): a config claiming
+    the Pallas codec kernels must really carry Mosaic custom-calls in its
+    TPU lowering — a silent fallback to the XLA-composed chain is the same
+    fraud class compressed-wire-present guards for the wire dtype."""
+
+    CFG = dict(bucket_cap_mb=1.0, wire_dtype="int8_multihop",
+               fused_quantize=True)
+    MOSAIC = ('  %fq = (s8[8,16384]{1,0}, f32[8,1]{1,0}) '
+              'custom-call(f32[8,16384]{1,0} %x), '
+              'custom_call_target="tpu_custom_call", '
+              'metadata={op_name="jit(step)/pallas_call'
+              '[name=fused_quantize_int8_rows]"}')
+    # a DIFFERENT Pallas kernel in the same step (flash attention lowers
+    # to the same tpu_custom_call target) — its presence must not vouch
+    # for the codec kernels
+    MOSAIC_ATTN = ('  %fa = f32[8,128,64]{2,1,0} '
+                   'custom-call(f32[8,128,64]{2,1,0} %q), '
+                   'custom_call_target="tpu_custom_call", '
+                   'metadata={op_name="jit(step)/pallas_call'
+                   '[name=flash_attention_fwd]"}')
+    # metadata-stripped render: kernel identity is unknowable, so bare
+    # presence has to suffice
+    MOSAIC_ANON = ('  %fq = (s8[8,16384]{1,0}, f32[8,1]{1,0}) '
+                   'custom-call(f32[8,16384]{1,0} %x), '
+                   'custom_call_target="tpu_custom_call"')
+
+    def test_mutation_missing_custom_call_flags(self):
+        a = _artifacts([big_allreduce()], config=self.CFG, backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present")
+
+    def test_mutation_other_kernel_does_not_mask_fallback(self):
+        """An attention Mosaic call with op_name metadata but NO codec
+        kernel is the silent-fallback-masked-by-another-kernel case."""
+        a = _artifacts([self.MOSAIC_ATTN, big_allreduce()],
+                       config=self.CFG, backend="tpu")
+        findings = _run(a, "fused-quantize-kernel-present")
+        assert findings and "masking" in findings[0].message
+
+    def test_tpu_lowering_with_mosaic_call_is_clean(self):
+        a = _artifacts([self.MOSAIC, big_allreduce()], config=self.CFG,
+                       backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+        # codec kernel present alongside another Pallas kernel: clean
+        a = _artifacts([self.MOSAIC_ATTN, self.MOSAIC, big_allreduce()],
+                       config=self.CFG, backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+
+    def test_metadata_stripped_render_accepts_presence(self):
+        a = _artifacts([self.MOSAIC_ANON, big_allreduce()],
+                       config=self.CFG, backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+
+    def test_auto_tristate_is_guarded_on_tpu(self, monkeypatch):
+        """fused_quantize=None (auto, THE production default) must resolve
+        exactly like the codec does — a TPU artifact whose auto resolves
+        to the kernel path is checked, not abstained on; auto resolved
+        off (env override) abstains."""
+        cfg = dict(self.CFG)
+        del cfg["fused_quantize"]  # auto
+        monkeypatch.setenv("DPT_FUSED_QUANTIZE", "1")
+        a = _artifacts([big_allreduce()], config=cfg, backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present")
+        a = _artifacts([self.MOSAIC, big_allreduce()], config=cfg,
+                       backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+        monkeypatch.setenv("DPT_FUSED_QUANTIZE", "0")
+        a = _artifacts([big_allreduce()], config=cfg, backend="tpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+
+    def test_cpu_interpreter_mode_abstains(self):
+        """Interpreter mode inlines the kernels as plain HLO — no
+        custom-call exists to assert; parity tests pin the numerics
+        (tests/test_quantize.py)."""
+        a = _artifacts([big_allreduce()], config=self.CFG, backend="cpu")
+        assert _run(a, "fused-quantize-kernel-present") == []
+        # unknown backend (hand-built artifacts) must also abstain
+        a = _artifacts([big_allreduce()], config=self.CFG)
+        assert _run(a, "fused-quantize-kernel-present") == []
+
+    def test_unfused_and_non_int8_configs_skip(self):
+        for cfg in (
+            dict(bucket_cap_mb=1.0, wire_dtype="int8_multihop"),  # no claim
+            dict(bucket_cap_mb=1.0, wire_dtype="bf16",
+                 fused_quantize=True),  # nothing to fuse on a bf16 wire
+        ):
+            a = _artifacts([big_allreduce()], config=cfg, backend="tpu")
+            assert _run(a, "fused-quantize-kernel-present") == [], cfg
+
+    def test_unengaged_codec_skips(self):
+        """One shard: the reducer never engages, the codec never runs — a
+        missing kernel is vacuous, not a violation."""
+        a = _artifacts([big_allreduce()], config=self.CFG, backend="tpu",
+                       n_shards=1)
+        assert _run(a, "fused-quantize-kernel-present") == []
+
+
 class TestDpSyncPresentRule:
     def test_mutation_vanished_grad_sync_flags(self):
         a = _artifacts(["  %p = f32[64]{0} parameter(0)"], config={})

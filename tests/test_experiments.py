@@ -169,6 +169,57 @@ def test_comm_overlap_split_math(tmp_path):
     assert split["exposed_frac_pct"] == round(100.0 * 50 / 120, 2)
 
 
+def test_comm_overlap_split_cross_pid_and_async_start(tmp_path):
+    """ISSUE-6 satellite: the two split properties only exercised
+    implicitly before. (a) Per-pid isolation — compute on ANOTHER device
+    never hides a collective (overlap is same-device concurrency, not
+    wall-clock coincidence). (b) Async ``-start`` events span the transfer
+    and are the measured interval; their ``-done`` completion markers (a
+    wait, not work) must add nothing."""
+    import gzip
+    import json
+
+    from distributed_pytorch_training_tpu.experiments.trace_analysis import (
+        comm_overlap_split,
+    )
+
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/device:TPU:1"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "name": "thread_name", "pid": 2, "tid": 1,
+         "args": {"name": "XLA Ops"}},
+        # device 0 compute: [0, 100)
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1", "ts": 0,
+         "dur": 100},
+        # (a) device 1 collective [10, 60) — device 0's compute must NOT
+        # hide it: device 1 runs nothing else, so it is fully exposed
+        {"ph": "X", "pid": 2, "tid": 1, "name": "all-gather.7", "ts": 10,
+         "dur": 50},
+        # (b) async start on device 0: [20, 70) spans the transfer, fully
+        # inside device 0's compute -> fully hidden
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce-start.3",
+         "ts": 20, "dur": 50},
+        # its completion marker: wait-not-work, counts nothing
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce-done.3",
+         "ts": 70, "dur": 400},
+    ]
+    d = tmp_path / "plugins"
+    d.mkdir()
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    split = comm_overlap_split(str(tmp_path))
+    assert split["collective_us"] == 100.0  # 50 (dev1) + 50 (async start)
+    assert split["hidden_us"] == 50.0       # only the same-device overlap
+    assert split["exposed_us"] == 50.0      # the cross-device one
+    assert split["exposed_frac_pct"] == 50.0
+
+
 def test_trace_census_ragged_all_to_all_and_async_pairing(tmp_path):
     """The widened trace regex (ISSUE 3 satellite): `ragged-all-to-all`
     (MoE dispatch) counts as communication, and an async `-start`/`-done`

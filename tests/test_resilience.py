@@ -163,6 +163,7 @@ class TestCheckpointIntegrity:
         mgr = CheckpointManager(str(tmp_path / "ckpt"))
         mgr.save(1, state, epoch=1)
         mgr.save(2, state, epoch=2)
+        mgr.wait()  # tampering below simulates POST-finalize corruption
         _truncate_largest(tmp_path / "ckpt" / "2")
 
         restored = mgr.restore_latest(state_factory())
@@ -182,6 +183,7 @@ class TestCheckpointIntegrity:
         mgr = CheckpointManager(str(tmp_path / "ckpt"))
         mgr.save(1, state, epoch=1)
         mgr.save(2, state, epoch=2)
+        mgr.wait()  # corrupt the FINALIZED files, not an in-flight write
         files = sorted(((tmp_path / "ckpt" / "2").rglob("*")),
                        key=lambda p: p.stat().st_size if p.is_file() else 0,
                        reverse=True)
@@ -200,6 +202,7 @@ class TestCheckpointIntegrity:
         state = state_factory()
         mgr = CheckpointManager(str(tmp_path / "ckpt"))
         mgr.save(3, state, epoch=3)
+        mgr.wait()
         manifest = tmp_path / "ckpt" / ".manifests" / "3.json"
         assert manifest.exists()
         manifest.unlink()
@@ -213,10 +216,149 @@ class TestCheckpointIntegrity:
         _trainer, state_factory, _ml = rig
         mgr = CheckpointManager(str(tmp_path / "ckpt"))
         mgr.save(1, state_factory(), epoch=1)
+        mgr.wait()
         _truncate_largest(tmp_path / "ckpt" / "1")
         assert mgr.restore_latest(state_factory()) is None
         mgr.close()
         assert "failed verification" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# async (snapshot-then-write) checkpointing
+# ---------------------------------------------------------------------------
+
+
+class TestAsyncSave:
+    """ISSUE 6 tentpole 1: ``save`` blocks only for the device→host
+    snapshot; the orbax write + manifest run on a background writer. The
+    async window must not widen the torn-checkpoint window silently, and a
+    failed background write must surface at the next save/wait barrier."""
+
+    def test_save_returns_before_write_finalizes(self, rig, tmp_path):
+        """The overlap itself: save() returns while the writer still holds
+        the un-finalized checkpoint (pending marker present, no manifest);
+        wait() finalizes it and the manifest verifies clean."""
+        _trainer, state_factory, _ml = rig
+        gate, entered = threading.Event(), threading.Event()
+
+        def hold(_label):
+            entered.set()
+            assert gate.wait(timeout=30.0)
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                pre_finalize_hook=hold)
+        mgr.save(1, state_factory(), epoch=1)
+        # save() already returned; the writer is parked inside the hook
+        # (after the orbax commit, before the manifest)
+        assert entered.wait(timeout=30.0)
+        manifests = tmp_path / "ckpt" / ".manifests"
+        assert (manifests / "1.pending").exists()
+        assert not (manifests / "1.json").exists()
+        gate.set()
+        mgr.wait()
+        assert (manifests / "1.json").exists()
+        assert not (manifests / "1.pending").exists()
+        assert mgr.verify(1) is None
+        mgr.close()
+
+    def test_blocked_time_collapses_to_snapshot(self, rig, tmp_path):
+        """The acceptance A/B (CPU mesh): with a 0.3s stall planted in the
+        write path, the sync save blocks the caller >=300ms; the async save
+        returns without paying it — blocked time ~= the snapshot cost."""
+        _trainer, state_factory, _ml = rig
+        state = state_factory()
+
+        def stall(_label):
+            time.sleep(0.3)
+
+        sync = CheckpointManager(str(tmp_path / "sync"), async_save=False,
+                                 pre_finalize_hook=stall)
+        sync.save(1, state, epoch=1)
+        sync_blocked = sync.save_blocked_ms
+        sync.close()
+
+        asyn = CheckpointManager(str(tmp_path / "async"),
+                                 pre_finalize_hook=stall)
+        asyn.save(1, state, epoch=1)
+        async_blocked = asyn.save_blocked_ms  # before wait(): the loop's view
+        asyn.wait()
+        asyn.close()
+        assert sync_blocked >= 300.0
+        assert async_blocked <= sync_blocked - 250.0  # the stall moved off
+        assert asyn.snapshot_ms <= async_blocked
+        assert asyn.saves_started == sync.saves_started == 1
+
+    def test_checkpoint_save_ab_instrument(self, rig, tmp_path):
+        """The bench instrument (experiments/harness.py): one sync + one
+        async throwaway save, blocked-ms per mode, nothing left on disk."""
+        from distributed_pytorch_training_tpu.experiments.harness import (
+            checkpoint_save_ab,
+        )
+
+        _trainer, state_factory, _ml = rig
+        out = checkpoint_save_ab(state_factory(), base_dir=str(tmp_path))
+        assert set(out) == {"sync_blocked_ms", "async_blocked_ms",
+                            "snapshot_ms", "write_ms"}
+        assert all(v >= 0.0 for v in out.values())
+        assert out["snapshot_ms"] <= out["async_blocked_ms"]
+        assert list(tmp_path.iterdir()) == []  # the A/B dir is gone
+
+    def test_crash_between_commit_and_finalize_skipped_loudly(
+            self, rig, tmp_path, capsys):
+        """CI satellite: a crash injected between the orbax commit and the
+        manifest finalize (the exact async window) leaves a checkpoint that
+        restore_latest skips LOUDLY — never one that masquerades as a
+        trusted legacy checkpoint — and a re-save over the torn label
+        recovers it fully."""
+        _trainer, state_factory, _ml = rig
+        inj = FaultInjector(FaultPlan.parse("crash_during_save@save=1"),
+                            log=lambda _m: None)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                pre_finalize_hook=inj.on_save_finalize)
+        state = state_factory()
+        mgr.save(1, state, epoch=1)
+        with pytest.raises(FaultError, match="crash_during_save"):
+            mgr.wait()  # the writer's death surfaces at the barrier
+        manifests = tmp_path / "ckpt" / ".manifests"
+        assert (manifests / "1.pending").exists()
+        assert not (manifests / "1.json").exists()
+        assert "never finalized" in mgr.verify(1)
+        assert mgr.restore_latest(state_factory()) is None
+        assert mgr.last_skipped == [1]
+        assert "never finalized" in capsys.readouterr().out
+        # the fault fired once: the replayed save must finalize normally
+        mgr.save(1, state, epoch=1)
+        mgr.wait()
+        assert mgr.verify(1) is None
+        restored = mgr.restore_latest(state_factory())
+        mgr.close()
+        assert restored is not None and restored[1] == 1
+
+    def test_failed_async_write_surfaces_at_next_save(self, rig, tmp_path):
+        """The other barrier: the NEXT save joins the failed write first
+        and re-raises — a lost checkpoint is never silent, and the next
+        attempt proceeds cleanly afterwards."""
+        _trainer, state_factory, _ml = rig
+        armed = {"on": True}
+
+        def hook(_label):
+            if armed["on"]:
+                armed["on"] = False
+                raise RuntimeError("disk gone")
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"),
+                                pre_finalize_hook=hook)
+        state = state_factory()
+        mgr.save(1, state, epoch=1)
+        with pytest.raises(RuntimeError, match="disk gone"):
+            mgr.save(2, state, epoch=2)
+        mgr.save(2, state, epoch=2)  # the error was consumed at the barrier
+        mgr.wait()
+        assert mgr.verify(2) is None
+        restored = mgr.restore_latest(state_factory())
+        mgr.close()
+        assert restored is not None and restored[1] == 2
+        assert "never finalized" in mgr.verify(1)  # the lost save is torn
 
 
 # ---------------------------------------------------------------------------
@@ -306,6 +448,68 @@ class TestSupervisor:
         assert report.completed
         assert report.preemptions_drained == 1
         assert report.restarts == 0  # a drain is not a failure
+        assert int(state.step) == 8
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+
+    def test_crash_during_save_recovered_bitwise(self, rig, tmp_path):
+        """ISSUE-6 acceptance: crash_during_save@save=2 kills the async
+        BACKGROUND writer between orbax commit and manifest. The failure
+        surfaces at the next save barrier — inside the recovery scope — so
+        the supervisor restores past the half-born checkpoint (integrity
+        skip via the pending marker), replays, and lands bitwise-equal to
+        the uninterrupted same-seed run with async saves enabled."""
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("crash_during_save@save=2"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save,
+                                 pre_finalize_hook=inj.on_save_finalize)
+        sup = Supervisor(trainer, ckpt, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=_FAST_RETRY, injector=inj,
+                         checkpoint_every_steps=2)
+        state, report = sup.run(epochs=2)
+        ckpt.close()
+        assert report.completed and report.restarts == 1
+        assert report.faults_fired == ["crash_during_save@save=2"]
+        assert report.checkpoints_skipped == 1  # the half-born label 4
+        assert report.fence_violations == 0
+        assert int(state.step) == 8
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+        _assert_bitwise_equal(state.batch_stats, control.batch_stats)
+
+    def test_relay_death_checkpoints_then_aborts_then_resumes(
+            self, rig, tmp_path, capsys):
+        """ISSUE-6 satellite: an advisory deathwatch reporting the relay
+        dead mid-epoch drains the segment at the next step boundary,
+        writes AND FLUSHES the checkpoint, and aborts with
+        report.relay_death — checkpoint-then-abort, not a bare rc=70. The
+        simulated relaunch resumes that exact step and lands bitwise."""
+        import types
+
+        trainer, state_factory, make_loader = rig
+        watch = types.SimpleNamespace(died=threading.Event(),
+                                      dead_ports=[8082])
+        watch.died.set()  # tunnel already dead at the first step boundary
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+        sup = Supervisor(trainer, ckpt, state_factory, make_loader(),
+                         retry=_FAST_RETRY, checkpoint_every_steps=2,
+                         deathwatch=watch)
+        state, report = sup.run(epochs=2)
+        ckpt.close()
+        assert report.relay_death and not report.completed
+        assert int(state.step) == 1  # drained after ONE step, mid-epoch
+        assert ckpt.verify(1) is None  # the abort save is flushed + intact
+        assert "relay tunnel died" in capsys.readouterr().out
+
+        ckpt2 = CheckpointManager(str(tmp_path / "ckpt"))
+        sup2 = Supervisor(trainer, ckpt2, state_factory, make_loader(),
+                          retry=_FAST_RETRY, checkpoint_every_steps=2)
+        state, report2 = sup2.run(epochs=2)
+        ckpt2.close()
+        assert report2.completed and not report2.relay_death
         assert int(state.step) == 8
         control = _control_params(trainer, state_factory, make_loader(), 2)
         _assert_bitwise_equal(state.params, control.params)
@@ -433,7 +637,8 @@ def test_chaos_cli_full_default_schedule(tmp_path, capsys):
     assert rc == 0
     assert stats["completed"] and stats["parity_bitwise"]
     assert set(stats["faults_fired"]) == {
-        "crash@step=3", "torn_ckpt@save=2", "sigterm@step=6"}
+        "crash@step=3", "torn_ckpt@save=2", "crash_during_save@save=2",
+        "sigterm@step=6"}
     assert stats["faults_unfired"] == []
 
 
@@ -445,6 +650,59 @@ def test_resilience_console_script_declared():
             '__main__:main"') in pyproject
     from distributed_pytorch_training_tpu.resilience.__main__ import main
     assert callable(main)
+
+
+# ---------------------------------------------------------------------------
+# TokenLoader fault hook (the LM loader's loader_stall injection point)
+# ---------------------------------------------------------------------------
+
+
+class TestTokenLoaderFaultHook:
+    """ISSUE-6 satellite (ROADMAP-carried): the LM TokenLoader carries the
+    same ``fault_hook`` / ``loader_stall`` support ShardedLoader has, with
+    the chaos injector driving it."""
+
+    def _loader(self, mesh, fault_hook=None):
+        from distributed_pytorch_training_tpu.data.text import (
+            TokenLoader, synthetic_token_dataset,
+        )
+
+        ds = synthetic_token_dataset(32, 16, 128, seed=0)
+        return TokenLoader(ds, mesh, per_device_batch=2, shuffle=True,
+                           seed=0, fault_hook=fault_hook)
+
+    def test_loader_stall_fires_and_batches_unchanged(self, mesh8):
+        """The chaos fault stalls exactly the targeted step and perturbs
+        NOTHING about the produced batches (deterministic sampler order is
+        the bitwise-parity foundation)."""
+        inj = FaultInjector(FaultPlan.parse("loader_stall@step=1:0.15s"),
+                            log=lambda _m: None)
+        plain = list(self._loader(mesh8).epoch(0))
+        t0 = time.monotonic()
+        stalled = list(self._loader(mesh8, inj.on_loader_batch).epoch(0))
+        assert time.monotonic() - t0 >= 0.15
+        assert inj.fired == ["loader_stall@step=1:0.15s"]
+        assert len(plain) == len(stalled) == 2  # 32 rows / global 16
+        for a, b in zip(plain, stalled):
+            np.testing.assert_array_equal(np.asarray(a["input_ids"]),
+                                          np.asarray(b["input_ids"]))
+            np.testing.assert_array_equal(np.asarray(a["weight"]),
+                                          np.asarray(b["weight"]))
+
+    def test_hook_sees_resume_offset(self, mesh8):
+        """A supervisor resume enters the epoch at start_step > 0: the hook
+        must see ABSOLUTE in-epoch indices (ShardedLoader's convention), or
+        a loader_stall@step=k fault would re-target after a restart."""
+        seen = []
+        list(self._loader(mesh8, seen.append).epoch(0, start_step=1))
+        assert seen == [1]
+
+    def test_train_py_wires_the_hook(self):
+        """train.py really passes the chaos injector into the LM loader
+        (the constraint was carried precisely because it didn't)."""
+        src = (REPO / "train.py").read_text()
+        lm_loader = src.split("train_loader = TokenLoader", 1)[1]
+        assert "fault_hook=(chaos.on_loader_batch" in lm_loader[:400]
 
 
 # ---------------------------------------------------------------------------
@@ -539,6 +797,37 @@ class TestHeartbeat:
         finally:
             srv_dies.close()
             srv_stays.close()
+
+    def test_advisory_watch_escalates_when_owner_wedges(self, monkeypatch):
+        """escalate_after_s: an advisory watch whose owner never exits
+        (the checkpoint-then-abort wedged in dead-relay RPC retries) must
+        fall through to the lethal hard exit — advisory mode cannot hang
+        strictly longer than the lethal watch it replaced."""
+        from distributed_pytorch_training_tpu.resilience import heartbeat
+
+        srv = _listener()
+        threading.Thread(target=_accept_forever, args=(srv,),
+                         daemon=True).start()
+        port = srv.getsockname()[1]
+        monkeypatch.setenv("DPT_RELAY_PORTS", str(port))
+        exits = []
+        monkeypatch.setattr(heartbeat, "hard_exit",
+                            lambda code: exits.append(code))
+        try:
+            watch = Deathwatch.arm(
+                policy=LivenessPolicy(interval_s=0.05,
+                                      connect_timeout_s=0.3, max_misses=3,
+                                      lethal=False, escalate_after_s=0.2),
+                log=lambda _m: None)
+            assert watch is not None
+            srv.close()  # total death: no survivor, no PJRT-close detour
+            assert watch.died.wait(timeout=10.0)
+            deadline = time.monotonic() + 10.0
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert exits == [heartbeat.DEATHWATCH_EXIT_CODE]
+        finally:
+            srv.close()
 
     def test_bench_consumes_the_shared_heartbeat(self):
         """The satellite's anti-drift pin: bench.py's port registry and

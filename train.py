@@ -115,6 +115,17 @@ def main(argv=None):
         guard.disarm()
 
 
+def _log_save_blocked(ckpt) -> None:
+    """The save_blocked_ms instrument (training/checkpoint.py): how long
+    the train loop actually stalled on checkpointing — under async saves
+    this collapses to ~the device→host snapshot cost."""
+    if ckpt is None or not ckpt.saves_started:
+        return
+    log_main(f"Checkpointing: blocked {ckpt.save_blocked_ms:.0f}ms total "
+             f"(snapshot {ckpt.snapshot_ms:.0f}ms) across "
+             f"{ckpt.saves_started} save(s)")
+
+
 def _run(args, guard):
     Path(args.output_dir).mkdir(parents=True, exist_ok=True)  # ref :316
 
@@ -135,11 +146,25 @@ def _run(args, guard):
     # environment a dead relay turns every RPC into an unbounded
     # UNAVAILABLE retry loop with no client-side remedy, so a training run
     # there should abort promptly (rc=70) instead of burning its
-    # preemption grace wedged. No-op everywhere else.
+    # preemption grace wedged. No-op everywhere else. Under the restart
+    # supervisor the watch is ADVISORY (lethal=False): the Supervisor
+    # drains the segment, flushes the pending async save, CHECKPOINTS,
+    # and only then this process exits rc=70 — checkpoint-then-abort
+    # instead of a bare kill, so the relaunch resumes this exact step.
     from distributed_pytorch_training_tpu.resilience.heartbeat import (
-        Deathwatch,
+        DEATHWATCH_EXIT_CODE, Deathwatch, default_policy,
     )
-    Deathwatch.arm(log=log_main)
+    relay_watch = None
+    if args.max_restarts > 0:
+        relay_watch = Deathwatch.arm(
+            # The abort path needs the in-flight step to RETURN, which a
+            # dead relay can prevent (unbounded UNAVAILABLE retries) —
+            # escalate to the lethal hard exit if the drain hasn't
+            # finished by then, same bound as preemption's hard exit.
+            policy=default_policy(lethal=False, escalate_after_s=600.0),
+            log=log_main)
+    else:
+        Deathwatch.arm(log=log_main)
     set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
     # Reuse compiles across CLI invocations on accelerators (the TPU analogue
     # of the reference's cudnn.benchmark=True autotune persistence, ref :329).
@@ -226,7 +251,9 @@ def _run(args, guard):
         )
 
         train_loader = TokenLoader(train_ds, mesh, args.batch_size, shuffle=True,
-                                   seed=args.seed, drop_last=args.drop_last)
+                                   seed=args.seed, drop_last=args.drop_last,
+                                   fault_hook=(chaos.on_loader_batch
+                                               if chaos else None))
         val_loader = TokenLoader(val_ds, mesh, args.batch_size, shuffle=False,
                                  seed=args.seed)
         lm_kwargs = dict(dtype=compute_dtype, remat=args.remat)
@@ -394,7 +421,10 @@ def _run(args, guard):
                                   bucket_cap_mb=args.bucket_cap_mb,
                                   wire_dtype=args.wire_dtype,
                                   overlap_grad_sync=not
-                                  args.no_overlap_grad_sync),
+                                  args.no_overlap_grad_sync,
+                                  fused_quantize={"auto": None, "on": True,
+                                                  "off": False}[
+                                                      args.fused_quantize]),
                       rules=rules)
     if args.zero1 and n_batch_shards > 1:
         log_main(f"ZeRO-1: weight update sharded {n_batch_shards}-way over "
@@ -456,7 +486,8 @@ def _run(args, guard):
         )
         ckpt = CheckpointManager(
             args.checkpoint_dir,
-            post_save_hook=chaos.on_save if chaos else None)
+            post_save_hook=chaos.on_save if chaos else None,
+            pre_finalize_hook=chaos.on_save_finalize if chaos else None)
         if args.resume:
             try:
                 restored = ckpt.restore_latest(state)
@@ -536,7 +567,7 @@ def _run(args, guard):
                          retry=RetryPolicy(max_restarts=args.max_restarts),
                          guard=guard, injector=chaos,
                          trust_existing=args.resume,
-                         epoch_end_cb=epoch_end)
+                         epoch_end_cb=epoch_end, deathwatch=relay_watch)
         state, report = sup.run(args.epochs,
                                 initial=(state, start_epoch, start_step))
         log_main(f"Supervisor: completed={report.completed} "
@@ -546,9 +577,15 @@ def _run(args, guard):
                  + (f" faults_fired={report.faults_fired}"
                     if report.faults_fired else ""))
         ckpt.wait()
+        _log_save_blocked(ckpt)
         ckpt.close()
         cleanup_distributed()  # ref :386
         guard.disarm()
+        if report.relay_death:
+            # the Supervisor already checkpointed-and-flushed; exit with
+            # the deathwatch's contract code so outer watchdogs key their
+            # crash-salvage branch exactly as for the lethal watch
+            sys.exit(DEATHWATCH_EXIT_CODE)
         return
 
     profiler = None
@@ -627,6 +664,7 @@ def _run(args, guard):
 
     if ckpt:
         ckpt.wait()  # finalize async writes before exit
+        _log_save_blocked(ckpt)
         ckpt.close()
     cleanup_distributed()  # ref :386
     # Only now is it safe to cancel the hard-exit deadline: a preempted
