@@ -131,6 +131,28 @@ EXTRA_CONFIGS = (
     ("gpt2_124m_gsync_mh", "gpt2_124m", 400,
      dict(per_device_batch=8, seq_len=1024, steps=10,
           grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_multihop"))),
+    # Explicit full-parameter FSDP (training/loop.py fsdp_explicit;
+    # SimpleFSDP, PAPERS.md): params + moments flat-sharded 1/N at rest,
+    # one just-in-time param all-gather per layer group, gradients
+    # reduce-scattered straight into the shard layout. On one chip the
+    # mode is an identity passthrough (regression canary); on multi-chip
+    # meshes these rows carry the per-layer gather census, the at-rest
+    # memory division, and the fsdp_gather_bytes wire term
+    # (experiments/scaling.py `fsdp` is the full instrumented arm). The
+    # _mh arm compresses BOTH wire directions (s8 scatter with EF + s8
+    # param gathers — ~2 B/element total at any DP degree); the 355m arm
+    # is the BASELINE flagship whose replicated params+moments cap the
+    # v4-32 pod config — the model this mode exists to unlock.
+    ("gpt2_124m_fsdp", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10,
+          grad_sync=dict(fsdp_explicit=True))),
+    ("gpt2_124m_fsdp_mh", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10,
+          grad_sync=dict(fsdp_explicit=True,
+                         wire_dtype="int8_multihop"))),
+    ("gpt2_355m_fsdp", "gpt2_355m", 420,
+     dict(per_device_batch=2, seq_len=1024, steps=6,
+          grad_sync=dict(fsdp_explicit=True))),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can

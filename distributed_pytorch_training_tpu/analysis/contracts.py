@@ -10,8 +10,10 @@ an acceptance gate, e.g. experiments/scaling.py).
 A `Contract` is one canonical training config (TrainConfig kwargs plus the
 floor below which collectives are metric noise). The matrix below is the
 set of configs whose compiled HLO must keep its promises on every PR:
-the plain data-parallel step, the zero1 sharded update, and the explicit
-bucketed reducer at each wire dtype, with and without grad accumulation.
+the plain data-parallel step, the zero1 sharded update, the explicit
+bucketed reducer at each wire dtype (with and without grad accumulation),
+and explicit full-parameter FSDP (fp32 and the fully compressed
+int8_multihop wire).
 `hlo_rules.evaluate_contract` lowers each on the CPU test mesh and runs
 every HLO rule over the result.
 """
@@ -189,6 +191,25 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "Mosaic custom-calls really lowered)",
              config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop",
                          fused_quantize=True), min_shards=2),
+    # Explicit full-parameter FSDP (ISSUE 7): params + moments flat-sharded
+    # 1/N at rest, one just-in-time param all-gather per layer group, one
+    # gradient reduce-scatter per layer group back into the shard layout.
+    # The fsdp-* rules bind here: gather count == layer groups, scatter
+    # signature present, no full-param/moment residency at rest.
+    Contract("fsdp", "explicit FSDP, exact fp32 gathers + fp32 scatter",
+             config=dict(fsdp_explicit=True), min_shards=2),
+    Contract("fsdp_accum",
+             "explicit FSDP under gradient accumulation (per-layer "
+             "scatters inside the microbatch scan; gathers stay one per "
+             "layer group in the step prologue)",
+             config=dict(fsdp_explicit=True, grad_accum=2), min_shards=2),
+    Contract("fsdp_int8_mh",
+             "explicit FSDP fully compressed: s8 per-layer gradient "
+             "scatter (error feedback) + s8 param gathers "
+             "(quantized_shard_all_gather) — both wire directions off "
+             "fp32, per-layer census unchanged",
+             config=dict(fsdp_explicit=True, wire_dtype="int8_multihop"),
+             min_shards=2),
 )
 
 

@@ -191,6 +191,19 @@ class StepArtifacts:
     # (path, n_elements) of optimizer-state leaves >= min_elements whose
     # sharding the evaluator found fully replicated (zero1 promises none).
     replicated_state_buffers: Tuple[Tuple[str, int], ...] = ()
+    # Same read over the PARAMETER leaves (explicit FSDP promises none:
+    # params live flat-sharded 1/N at rest — a replicated param buffer
+    # means the mode is paying replicated memory while claiming the
+    # division). Filled only for fsdp configs.
+    replicated_param_buffers: Tuple[Tuple[str, int], ...] = ()
+    # Per-group full padded element counts (n_shards x row_size, one per
+    # LayerGroup of the trainer's grad_sync.build_layer_plan) — the
+    # fsdp-layer-gather-bound / scatter-signature budget. The SIZES ride
+    # along (not just the count) because the census floor hides sub-floor
+    # groups (a tiny final layernorm's gather is metric noise by design):
+    # the rules compute floor-aware expected counts from these. Empty when
+    # the config is not explicit-FSDP.
+    layer_group_padded_sizes: Tuple[int, ...] = ()
     # the backend the config was lowered FOR ("tpu"/"cpu"/...): rules whose
     # promise only exists in one backend's lowering (fused-quantize-kernel-
     # present: Pallas emits a custom-call on TPU but inlines as plain HLO
@@ -206,9 +219,18 @@ class StepArtifacts:
         return bool(self.config.get("zero1")) and self.n_shards > 1
 
     @property
+    def fsdp_engaged(self) -> bool:
+        """Mirrors Trainer's engagement condition for explicit FSDP."""
+        return bool(self.config.get("fsdp_explicit")) and self.n_shards > 1
+
+    @property
     def grad_sync_engaged(self) -> bool:
-        """Mirrors Trainer's engagement condition for the explicit reducer."""
-        return (not self.config.get("zero1") and self.n_shards > 1
+        """Mirrors Trainer's engagement condition for the explicit reducer
+        (fsdp_explicit owns its own wire layout — the per-layer cut — so a
+        compressed wire under fsdp is NOT the bucketed reducer)."""
+        return (not self.config.get("zero1")
+                and not self.config.get("fsdp_explicit")
+                and self.n_shards > 1
                 and (float(self.config.get("bucket_cap_mb", 0.0)) > 0
                      or self.wire_mode != "fp32"))
 
@@ -290,7 +312,8 @@ def check_bucket_bound(a: StepArtifacts, slack: int = 2) -> List[Finding]:
       "a silent fallback to fp32 operands erases the wire-byte win while "
       "the flag still claims it (the ISSUE-2 acceptance check).")
 def check_compressed_wire(a: StepArtifacts) -> List[Finding]:
-    if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged):
+    if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged
+                                     or a.fsdp_engaged):
         return []
     if a.preopt_text is None:
         # No reliable wire read: CPU's float-normalization promotes bf16
@@ -316,7 +339,8 @@ def check_compressed_wire(a: StepArtifacts) -> List[Finding]:
       "if every gradient byte is compressed. The zero1 parameter "
       "all-gather is exempt: it is exact by design.")
 def check_no_fp32_wire(a: StepArtifacts) -> List[Finding]:
-    if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged):
+    if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged
+                                     or a.fsdp_engaged):
         return []
     if a.preopt_text is None:
         return []  # no reliable wire read — see check_compressed_wire
@@ -385,6 +409,131 @@ def check_zero1_sharded_state(a: StepArtifacts) -> List[Finding]:
     return []
 
 
+@rule("fsdp-layer-gather-bound", "hlo",
+      "explicit FSDP gathers params exactly once per layer group",
+      "the just-in-time per-layer gather IS the mode (SimpleFSDP, "
+      "PAPERS.md): fewer gathers than layer groups means some layer reads "
+      "stale or GSPMD-materialized full params; more means the per-layer "
+      "plan degenerated into per-leaf traffic (the O(leaves) failure the "
+      "LayerPlan exists to prevent). The budget comes from the trainer's "
+      "build_layer_plan, never hard-coded.")
+def check_fsdp_gather_bound(a: StepArtifacts) -> List[Finding]:
+    if not a.fsdp_engaged:
+        return []
+    sizes = a.layer_group_padded_sizes
+    if not sizes:
+        return [Finding(
+            "fsdp-layer-gather-bound",
+            "fsdp config evaluated without a layer-plan budget "
+            "(layer_group_padded_sizes empty) — the evaluator must "
+            "snapshot the trainer's LayerPlan group sizes", a.name)]
+    # A group's gather result carries its FULL padded size (fp32 f32 or
+    # multihop s8 codes — same element count); groups under the census
+    # floor are invisible by design, so the expectation is floor-aware.
+    expected = sum(1 for s in sizes if s >= a.min_elements)
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    gathers = census["by_op"].get("all-gather", 0)
+    if gathers != expected:
+        return [Finding(
+            "fsdp-layer-gather-bound",
+            f"fsdp step carries {gathers} gradient/param-sized "
+            f"all-gather(s), expected exactly {expected} (one per layer "
+            f"group over the census floor; {len(sizes)} group(s), "
+            f"{len(sizes) - expected} under min_elements="
+            f"{a.min_elements}): {census['by_op']}", a.name)]
+    return []
+
+
+@rule("fsdp-scatter-into-shard", "hlo",
+      "explicit FSDP reduce-scatters each layer's gradient into the shard "
+      "layout, with no gradient-sized all-reduce",
+      "the scatter-into-shard signature: gradients must land as 1/N "
+      "chunks (reduce-scatter, or the s8 all-to-all under the int8 "
+      "codec), one per layer group. A surviving gradient-sized all-reduce "
+      "means the step synced replicated gradients and the at-rest "
+      "sharding is cosmetic.")
+def check_fsdp_scatter_signature(a: StepArtifacts) -> List[Finding]:
+    if not a.fsdp_engaged:
+        return []
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    by_op = census["by_op"]
+    out = []
+    scatters = by_op.get("reduce-scatter", 0) + by_op.get("all-to-all", 0)
+    sizes = a.layer_group_padded_sizes
+    if sizes:
+        # Floor-aware expectation, per wire: the s8 codec's all-to-all
+        # result carries the group's FULL padded size, a plain
+        # reduce-scatter's result is the 1/N destination chunk — the same
+        # group can be census-visible under one wire and not the other.
+        if a.wire_mode in ("int8", "int8_multihop"):
+            expected = sum(1 for s in sizes if s >= a.min_elements)
+        else:
+            expected = sum(1 for s in sizes
+                           if s // max(a.n_shards, 1) >= a.min_elements)
+        if scatters != expected:
+            out.append(Finding(
+                "fsdp-scatter-into-shard",
+                f"fsdp step carries {scatters} gradient-sized "
+                f"reduce-scatter/all-to-all(s), expected exactly "
+                f"{expected} (one per layer group whose scatter result "
+                f"clears the census floor; {len(sizes)} group(s), "
+                f"min_elements={a.min_elements}, wire={a.wire_mode}): "
+                f"{by_op}", a.name))
+    if by_op.get("all-reduce", 0):
+        out.append(Finding(
+            "fsdp-scatter-into-shard",
+            f"fsdp step still contains {by_op['all-reduce']} gradient-"
+            "sized all-reduce(s) — gradients are being synced replicated "
+            "instead of scattered into the shard layout", a.name))
+    return out
+
+
+# Entry parameters the compiled module keeps fully replicated:
+# `%param = f32[...] parameter(k), sharding={replicated}`. Index the shape
+# from the same line so the check needs no cross-line state.
+_REPLICATED_ENTRY_PARAM_RE = re.compile(
+    r"=\s*(\S+\[[\d,]*\][^ ]*)\s+parameter\(\d+\)[^\n]*"
+    r"sharding=\{replicated\}")
+
+
+@rule("fsdp-no-full-param-residency", "hlo",
+      "no parameter/moment-sized buffer is replicated at rest under "
+      "explicit FSDP",
+      "dividing at-rest parameter+moment memory by the DP degree is the "
+      "mode's whole point; a replicated param input in the lowered module "
+      "(or a replicated live buffer on the state) means the step is "
+      "paying full residency while the flag claims the division — the "
+      "zero1-sharded-state argument extended to the parameters "
+      "themselves.")
+def check_fsdp_no_full_param_residency(a: StepArtifacts) -> List[Finding]:
+    if not a.fsdp_engaged:
+        return []
+    out = []
+    for label, buffers in (("parameter", a.replicated_param_buffers),
+                           ("optimizer-state", a.replicated_state_buffers)):
+        if buffers:
+            rows = ", ".join(f"{p} ({n} elements)" for p, n in buffers[:5])
+            more = len(buffers) - 5
+            out.append(Finding(
+                "fsdp-no-full-param-residency",
+                f"{len(buffers)} {label} buffer(s) >= {a.min_elements} "
+                f"elements are fully replicated under fsdp_explicit: "
+                f"{rows}" + (f" (+{more} more)" if more > 0 else ""),
+                a.name))
+    # the lowered-module read: entry parameters the compiled step takes as
+    # REPLICATED operands at gradient/param scale (the live-state read
+    # above can miss a layout the compiler re-materializes)
+    big = [m.group(1) for m in
+           _REPLICATED_ENTRY_PARAM_RE.finditer(a.optimized_text)
+           if hlo_result_elements(m.group(1)) >= a.min_elements]
+    if big:
+        out.append(Finding(
+            "fsdp-no-full-param-residency",
+            f"compiled fsdp step takes {len(big)} replicated entry "
+            f"parameter(s) at gradient/param scale: {big[:5]}", a.name))
+    return out
+
+
 @rule("donated-buffers-elided", "hlo",
       "donate_state really aliases input and output buffers",
       "a step that copies the full parameters instead of updating them "
@@ -432,7 +581,7 @@ _QUANTIZE_KERNEL_NAMES = ("fused_quantize_int8_rows",
 def check_fused_quantize_kernel(a: StepArtifacts) -> List[Finding]:
     if a.wire_mode not in ("int8", "int8_multihop"):
         return []  # no int8 codec in the step — nothing to fuse
-    if not (a.grad_sync_engaged or a.zero1_engaged):
+    if not (a.grad_sync_engaged or a.zero1_engaged or a.fsdp_engaged):
         return []  # passthrough config: the codec never runs
     fused = a.config.get("fused_quantize")
     if fused is None and a.backend == "tpu":
@@ -511,7 +660,8 @@ def check_no_host_transfer(a: StepArtifacts) -> List[Finding]:
       "model's gradient traffic — the dp arm proves the instrument sees "
       "the all-reduce DDP's reducer would issue.")
 def check_dp_sync_present(a: StepArtifacts) -> List[Finding]:
-    if (a.zero1_engaged or a.grad_sync_engaged or a.n_shards <= 1
+    if (a.zero1_engaged or a.grad_sync_engaged or a.fsdp_engaged
+            or a.n_shards <= 1
             or int(a.config.get("grad_accum", 1)) > 1):
         # grad-accum keeps sync inside a scan; count it only on the plain arm
         return []
@@ -618,9 +768,15 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         preopt = None
     plan = build_bucket_plan(state.params,
                              float(contract.config.get("bucket_cap_mb", 0.0)))
+    is_fsdp = bool(contract.config.get("fsdp_explicit"))
     replicated = (replicated_large_buffers(state.opt_state,
                                            contract.min_elements)
-                  if contract.config.get("zero1") else ())
+                  if (contract.config.get("zero1") or is_fsdp) else ())
+    replicated_params = (replicated_large_buffers(state.params,
+                                                  contract.min_elements)
+                        if is_fsdp else ())
+    group_sizes = (trainer._fsdp_plan.padded_group_sizes
+                   if is_fsdp and trainer._fsdp_plan is not None else ())
     return StepArtifacts(
         name=contract.name,
         optimized_text=optimized,
@@ -630,6 +786,8 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         total_grad_bytes=plan.total_bytes,
         min_elements=contract.min_elements,
         replicated_state_buffers=replicated,
+        replicated_param_buffers=replicated_params,
+        layer_group_padded_sizes=group_sizes,
         backend=jax.default_backend(),
     )
 

@@ -120,12 +120,14 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
                                               zero1=zero1,
                                               **(grad_sync or {})),
                       rules=type(model).partition_rules())
-    # zero1 shards the update; the AdamW global-norm clip must psum across
-    # the shards or each replica clips by its own shard's norm (optim.py).
-    # On a single batch shard the Trainer runs the replicated (non-
-    # shard_map) path, where a psum over the batch axes would hit unbound
-    # axis names — shard_axes must follow the SAME passthrough condition.
-    sharded = zero1 and batch_shard_count(mesh) > 1
+    # zero1/fsdp shard the update; the AdamW global-norm clip must psum
+    # across the shards or each replica clips by its own shard's norm
+    # (optim.py). On a single batch shard the Trainer runs the replicated
+    # (non-shard_map) path, where a psum over the batch axes would hit
+    # unbound axis names — shard_axes must follow the SAME passthrough
+    # condition.
+    sharded = (zero1 or bool((grad_sync or {}).get("fsdp_explicit"))) \
+        and batch_shard_count(mesh) > 1
     tx = adamw(1e-4, shard_axes=BATCH_AXES if sharded else None)
     state = trainer.init_state(model, np.zeros((1, seq_len), np.int32),
                                tx, jax.random.PRNGKey(0))
@@ -312,6 +314,7 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
         cfg = dict(grad_sync or {})
         cfg["zero1"] = bool(zero1)
         cfg["donate_state"] = trainer.config.donate_state
+        is_fsdp = bool(cfg.get("fsdp_explicit"))
         try:
             preopt = preopt_hlo_text(lowered)
         except Exception:
@@ -328,7 +331,13 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
             total_grad_bytes=plan.total_bytes,
             replicated_state_buffers=(
                 replicated_large_buffers(state.opt_state, 8192)
-                if zero1 else ()),
+                if (zero1 or is_fsdp) else ()),
+            replicated_param_buffers=(
+                replicated_large_buffers(state.params, 8192)
+                if is_fsdp else ()),
+            layer_group_padded_sizes=(
+                trainer._fsdp_plan.padded_group_sizes
+                if is_fsdp and trainer._fsdp_plan is not None else ()),
         )
         findings = check_artifacts(artifacts)
         return {"pass": not findings,
@@ -445,9 +454,12 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         compiled = lowered.compile()
 
         xla_flops = flops_mod.xla_flops_per_step(compiled)
+        # fsdp_explicit states hold flat-sharded params — the analytic
+        # model needs them back in model shapes (train.py does the same)
         analytic_fwd = flops_mod.jaxpr_matmul_flops(
             lambda s, b: trainer.task.loss_and_metrics(
-                s, s.params, b, key, train=True)[0], state, batch)
+                s, trainer._fsdp_unflatten(s.params) if trainer._fsdp
+                else s.params, b, key, train=True)[0], state, batch)
 
         from ..parallel.grad_sync import wire_bytes_for_config
         from ..parallel.mesh import batch_shard_count
@@ -463,9 +475,21 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # bucketed/replicated reducer's; zero1's split wire (compressed
         # scatter + exact param gather) is out of its scope — omitted.
         wire_bytes = None
+        gather_bytes = None
         if not zero1:
             wire_bytes = wire_bytes_for_config(
                 state.params, grad_sync, batch_shard_count(trainer.mesh))
+            if trainer._fsdp:
+                # the per-layer param-gather traffic term alone (ISSUE 7):
+                # wire_bytes above is scatter + gather; recording the
+                # gather split lets bench history see which direction a
+                # wire-mode change moved. state.params' flat leaves carry
+                # the same padded totals as the model shapes.
+                from ..parallel.grad_sync import fsdp_gather_bytes
+                gather_bytes = fsdp_gather_bytes(
+                    state.params,
+                    (grad_sync or {}).get("wire_dtype", "fp32"),
+                    batch_shard_count(trainer.mesh))
 
         exposed_comm_pct = None
         if comm_trace and len(devices) > 1:
@@ -537,6 +561,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         "grad_wire_dtypes": sync_census["wire_dtypes"],
         **({"wire_bytes_per_replica": wire_bytes}
            if wire_bytes is not None else {}),
+        **({"fsdp_gather_bytes": gather_bytes}
+           if gather_bytes is not None else {}),
         # per-arm parallelism-contract verdict (analysis/hlo_rules.py):
         # bench history records whether the measured executable kept its
         # collective/wire/donation promises, not just how fast it ran

@@ -365,6 +365,75 @@ def run_grad_sync(args) -> List[dict]:
     return rows
 
 
+def run_fsdp(args) -> List[dict]:
+    """Replicated vs explicit full-parameter FSDP on the same devices
+    (training/loop.py fsdp_explicit; SimpleFSDP, PAPERS.md): same model,
+    same data-parallel mesh, once with replicated params (the DDP layout)
+    and once with params + moments flat-sharded 1/N at rest, gathered
+    just-in-time per layer — plus the fully compressed int8_multihop arm
+    (s8 gradient scatter with EF + s8 param gathers).
+
+    Each row carries (a) throughput, (b) the per-layer collective census
+    of the compiled step — all-gather count must equal the LayerPlan's
+    group count, scatters must land as 1/N chunks (the analysis/ fsdp
+    contracts, read here as recorded numbers), (c) the at-rest per-replica
+    parameter bytes — the memory-division claim as a number, not a
+    docstring — and (d) `wire_bytes_per_replica` with its
+    `fsdp_gather_bytes` term split out, so the gather-traffic cost of the
+    mode is accounted per wire dtype (the int8_multihop gathers are
+    ~1 B/element, n-independent; fp32 gathers are exact at ~4 B/element).
+    `--grad-accum` > 1 exercises the in-scan per-layer scatter overlap."""
+    from ..parallel.grad_sync import fsdp_gather_bytes, wire_bytes_for_config
+    from ..parallel.mesh import batch_shard_count
+    from .trace_analysis import grad_sync_census
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return [{"mode": "skipped",
+                 "global_samples_per_s": "needs >= 2 devices"}]
+    accum = args.grad_accum
+    modes = [("replicated", None),
+             ("fsdp_fp32", dict(fsdp_explicit=True)),
+             ("fsdp_int8_multihop",
+              dict(fsdp_explicit=True, wire_dtype="int8_multihop"))]
+    rows = []
+    for mode, gs in modes:
+        gs_full = (dict(gs or {}, grad_accum=accum)
+                   if (gs or accum > 1) else gs)
+        trainer, state, mesh, batch, gb = _setup(devices, args.bf16, args,
+                                                 grad_sync=gs_full)
+        n = batch_shard_count(mesh)
+        compiled = trainer._train_step.lower(
+            state, batch, jax.random.PRNGKey(0)).compile()
+        census = grad_sync_census(compiled.as_text())
+        by_op = census["by_op"]
+        # at-rest parameter residency per replica: fsdp's flat leaves are
+        # sharded 1/N, the replicated arm holds every byte everywhere
+        param_bytes = sum(
+            int(leaf.size) * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(state.params))
+        at_rest = param_bytes // n if trainer._fsdp else param_bytes
+        wire = (gs or {}).get("wire_dtype", "fp32")
+        gather_bytes = (fsdp_gather_bytes(state.params, wire, n)
+                        if trainer._fsdp else 0)
+        wire_bytes = wire_bytes_for_config(state.params, gs_full, n)
+        _, sps = timed_steps(compiled, state, batch, gb, args.steps,
+                             repeats=args.repeats,
+                             min_window_s=args.min_window_s)
+        rows.append({
+            "mode": mode,
+            "global_samples_per_s": round(sps, 1),
+            "all_gathers": by_op.get("all-gather", 0),
+            "grad_scatters": (by_op.get("reduce-scatter", 0)
+                              + by_op.get("all-to-all", 0)),
+            "grad_all_reduce": by_op.get("all-reduce", 0),
+            "param_bytes_at_rest_per_replica": at_rest,
+            "wire_bytes_per_replica": wire_bytes,
+            "fsdp_gather_bytes": gather_bytes,
+        })
+    return rows
+
+
 def run_pipeline(args) -> List[dict]:
     """GPipe bubble measurement: pipelined GPT-2 throughput vs microbatch
     count, against the pure-DP layout of the same model on the same devices.
@@ -443,7 +512,7 @@ def main(argv=None):
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
                    choices=["scaling", "batch", "amp", "gradsync",
-                            "grad_sync", "zero1", "pipeline"])
+                            "grad_sync", "zero1", "fsdp", "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
@@ -468,16 +537,17 @@ def main(argv=None):
                         "(training/loop.py explicit reducer; DDP's "
                         "default is 25)")
     p.add_argument("--grad-accum", default=1, type=int,
-                   help="gradient accumulation for the 'grad_sync' "
-                        "experiment (> 1 exercises the in-scan overlap "
-                        "and adds a no-overlap arm)")
+                   help="gradient accumulation for the 'grad_sync' and "
+                        "'fsdp' experiments (> 1 exercises the in-scan "
+                        "overlap; grad_sync adds a no-overlap arm)")
     p.add_argument("--csv", default=None,
                    help="append rows to this CSV (plots regenerate from it)")
     args = p.parse_args(argv)
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
           "gradsync": run_gradsync, "grad_sync": run_grad_sync,
-          "zero1": run_zero1, "pipeline": run_pipeline}[args.experiment]
+          "zero1": run_zero1, "fsdp": run_fsdp,
+          "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "
           f"{len(jax.devices())} device(s) [{jax.default_backend()}]\n")

@@ -174,7 +174,6 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
     from .flash_attention import _flash_fwd_lse
 
     n = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -185,8 +184,15 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, sm_scale,
 
     def step(carry, t):
         k_cur, v_cur, o, lse = carry
-        j = (my - t) % n  # which global shard this K/V block is
         if causal:
+            # which global shard this K/V block is. my/j are computed only
+            # when consumed: left dead (the non-causal path never reads
+            # them), the axis_index survives the custom_vjp partial-eval
+            # un-DCE'd in the scan body and lowers to a bare partition-id
+            # HLO op the SPMD partitioner rejects (jax 0.4.x — the
+            # TestRingFlashFused PartitionId failure)
+            my = lax.axis_index(axis_name)
+            j = (my - t) % n
             # diagonal -> causal kernel; past -> full kernel; future ->
             # skipped entirely (the ~2x causal win the einsum ring only
             # gets as masked-but-computed blocks)
@@ -234,7 +240,6 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k,
 
     q, k, v, out, lse = residuals
     n = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -250,8 +255,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, sm_scale, block_q, block_k,
 
     def step(carry, t):
         k_cur, v_cur, dk_cur, dv_cur, dq = carry
-        j = (my - t) % n
         if causal:
+            my = lax.axis_index(axis_name)
+            j = (my - t) % n  # only computed when consumed — see fwd
             dq_j, dk_j, dv_j = lax.cond(
                 j == my,
                 lambda: bwd_block(k_cur, v_cur, True),

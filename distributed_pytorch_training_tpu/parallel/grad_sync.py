@@ -188,16 +188,160 @@ def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
     return 2 * padded_total_size(plan, n_shards)
 
 
+def _flat_padded_total(params: Any, n_shards: int) -> int:
+    """Sum of every leaf's flat-padded size — the element count that rides
+    the explicit-FSDP wire (gathers and scatters both operate on the
+    padded-to-n per-leaf layout)."""
+    from .sharding import flat_padded_size
+
+    return int(sum(
+        flat_padded_size(int(np.prod(np.shape(leaf)) or 1), n_shards)
+        for leaf in jax.tree_util.tree_leaves(params)))
+
+
+def fsdp_gather_bytes(params: Any, wire_dtype: str, n_shards: int) -> int:
+    """Per-replica wire bytes of ONE full per-layer parameter gather pass
+    under explicit FSDP (`fsdp_explicit`) — the gather-traffic term
+    `wire_bytes_for_config` adds for that mode, recorded in bench/scaling
+    rows (satellite of ISSUE 7).
+
+    Conventions (payload only, scale sidebands excluded as noise): the
+    fp32/bf16/int8 wires gather parameters EXACTLY (fp32 on the wire,
+    mirroring zero1's exact param gather) — ~4 bytes x padded elements per
+    replica. ``int8_multihop`` gathers s8 codes + per-chunk fp32 scales
+    (`quantized_shard_all_gather`) — ~1 byte/element, independent of the
+    shard count (the delta-gather n-independence argument, applied to the
+    absolute shard values)."""
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(f"unknown wire dtype {wire_dtype!r} "
+                         f"(choose from {WIRE_DTYPES})")
+    if n_shards <= 1:
+        return 0  # passthrough: nothing rides the wire
+    total = _flat_padded_total(params, n_shards)
+    return total if wire_dtype == "int8_multihop" else 4 * total
+
+
 def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
                           n_shards: int) -> int:
     """`wire_bytes_per_replica` from a TrainConfig-style override dict
-    (``bucket_cap_mb`` / ``wire_dtype``, with the TrainConfig defaults) —
-    the ONE accounting call both bench (`harness.measure_config`) and
-    scaling (`run_grad_sync`) record, so their rows cannot drift apart."""
+    (``bucket_cap_mb`` / ``wire_dtype`` / ``fsdp_explicit``, with the
+    TrainConfig defaults) — the ONE accounting call both bench
+    (`harness.measure_config`) and scaling (`run_grad_sync` / `run_fsdp`)
+    record, so their rows cannot drift apart.
+
+    For ``fsdp_explicit`` configs the number is scatter + gather: the
+    gradient reduce-scatter at the wire dtype (4/2/1/1 bytes per padded
+    element for fp32/bf16/int8/int8_multihop — a reduce-scatter is half an
+    all-reduce) plus the `fsdp_gather_bytes` per-layer gather term. Only
+    ``int8_multihop`` compresses both directions (~2 B/element total,
+    independent of n — asserted by tests, like the multihop gradient
+    wire's)."""
     cfg = dict(grad_sync_cfg or {})
+    wire = cfg.get("wire_dtype", "fp32")
+    if wire not in WIRE_DTYPES:
+        raise ValueError(f"unknown wire dtype {wire!r} "
+                         f"(choose from {WIRE_DTYPES})")
+    if cfg.get("fsdp_explicit"):
+        if n_shards <= 1:
+            return 0
+        total = _flat_padded_total(params, n_shards)
+        scatter = {"fp32": 4, "bf16": 2, "int8": 1,
+                   "int8_multihop": 1}[wire] * total
+        return scatter + fsdp_gather_bytes(params, wire, n_shards)
     plan = build_bucket_plan(params, float(cfg.get("bucket_cap_mb", 0.0)))
-    return wire_bytes_per_replica(plan, cfg.get("wire_dtype", "fp32"),
-                                  n_shards)
+    return wire_bytes_per_replica(plan, wire, n_shards)
+
+
+# ---------------------------------------------------------------------------
+# Layer plan (explicit FSDP): the per-layer cut of the parameter tree
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerGroup:
+    """One per-layer gather/scatter unit of the explicit-FSDP wire layout.
+
+    ``leaf_slots`` index into the params tree's ``tree_leaves`` order;
+    ``chunk_sizes[i]`` is leaf ``leaf_slots[i]``'s per-replica chunk
+    (flat-padded size / n_shards). The group's WIRE LAYOUT is
+    destination-major: row j = the concatenation of every member leaf's
+    chunk j — so ONE tiled all-gather of this replica's row rebuilds every
+    member leaf's flat-padded vector, and ONE reduce-scatter of the
+    row-stacked gradient lands each leaf's chunk back on its owner.
+    """
+
+    name: str
+    leaf_slots: Tuple[int, ...]
+    chunk_sizes: Tuple[int, ...]
+
+    @property
+    def row_size(self) -> int:
+        """Per-replica elements of this group (one gather/scatter row)."""
+        return int(sum(self.chunk_sizes))
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerPlan:
+    """Static per-layer layout of a parameter tree for explicit FSDP —
+    the BucketPlan idea applied to the MODEL's structure instead of a byte
+    cap: one group per top-level module (`wte`, `block0`, ..., `ln_f`), so
+    the step carries one just-in-time param gather and one gradient
+    reduce-scatter per layer. Built from SHAPES only (host-side, identical
+    at trace time and across processes)."""
+
+    groups: Tuple[LayerGroup, ...]
+    n_shards: int
+
+    @property
+    def total_padded(self) -> int:
+        return self.n_shards * sum(g.row_size for g in self.groups)
+
+    @property
+    def padded_group_sizes(self) -> Tuple[int, ...]:
+        """Full padded elements per group (n_shards x row_size) — the ONE
+        budget the analysis/ fsdp rules read (contract evaluator and bench
+        `_contract_check` both snapshot this, so their expectations cannot
+        drift)."""
+        return tuple(self.n_shards * g.row_size for g in self.groups)
+
+
+def _top_level_key(path) -> str:
+    if not path:
+        return "params"
+    p = path[0]
+    for attr in ("key", "name", "idx"):
+        if hasattr(p, attr):
+            return str(getattr(p, attr))
+    return str(p)
+
+
+def build_layer_plan(params: Any, n_shards: int) -> LayerPlan:
+    """Group ``params`` into per-layer gather units by top-level key.
+
+    Grouping by the first path component makes each transformer block (and
+    each standalone module: embeddings, final layernorm) one gather — the
+    per-layer granularity SimpleFSDP gathers at. Leaves keep their
+    ``tree_leaves`` order inside a group, so slicing a gathered row back
+    into leaves is pure static arithmetic."""
+    from .sharding import flat_padded_size
+
+    by_key: dict = {}
+    order: List[str] = []
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    for slot, (path, leaf) in enumerate(leaves):
+        key = _top_level_key(path)
+        if key not in by_key:
+            by_key[key] = []
+            order.append(key)
+        size = int(np.prod(np.shape(leaf)) or 1)
+        by_key[key].append((slot, flat_padded_size(size, n_shards)
+                            // n_shards))
+    groups = tuple(
+        LayerGroup(name=k,
+                   leaf_slots=tuple(s for s, _ in by_key[k]),
+                   chunk_sizes=tuple(c for _, c in by_key[k]))
+        for k in order)
+    return LayerPlan(groups=groups, n_shards=n_shards)
 
 
 def flatten_tree(tree: Any) -> jnp.ndarray:
@@ -350,11 +494,7 @@ def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
     partial = _dequant_sum_rows(recv_q.reshape(n_shards, chunk),
                                 recv_scales, fused=fused)  # (chunk,) fp32
     # hop 2: requantize the partial sum, gather codes + scales, dequant
-    q2, scale2 = _quantize_int8(partial, fused=fused)
-    gathered = lax.all_gather(q2, names, axis=0, tiled=True)  # (padded,) s8
-    g_scales = lax.all_gather(scale2[None], names, axis=0, tiled=True)
-    out = (gathered.reshape(n_shards, chunk).astype(jnp.float32)
-           * g_scales[:, None]).reshape(-1)
+    out = _s8_all_gather_dequant(partial, names, fused=fused)
     return out[:size], new_residual
 
 
@@ -435,6 +575,23 @@ def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
     return synced, new_residual
 
 
+def _s8_all_gather_dequant(chunk: jnp.ndarray, names: Tuple[str, ...],
+                           fused: Optional[bool] = None) -> jnp.ndarray:
+    """The shared s8 gather wire: quantize this replica's (chunk,) fp32
+    vector with ONE max-abs scale, all-gather codes (s8 on the wire) +
+    scales (n fp32 scalars, noise), dequantize identically everywhere.
+    Returns the full (n x chunk,) fp32 reconstruction — exactly
+    replica-identical because every replica dequantizes the same
+    (codes, scales). One convention, three wires: multihop's hop 2,
+    zero1's delta gather, and the explicit-FSDP shard gather."""
+    q, scale = _quantize_int8(chunk, fused=fused)
+    gathered = lax.all_gather(q, names, axis=0, tiled=True)
+    scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
+    n = scales.shape[0]
+    return (gathered.reshape(n, -1).astype(jnp.float32)
+            * scales[:, None]).reshape(-1)
+
+
 def quantized_delta_all_gather(new_shard: jnp.ndarray,
                                old_shard: jnp.ndarray,
                                old_flat: jnp.ndarray,
@@ -465,14 +622,35 @@ def quantized_delta_all_gather(new_shard: jnp.ndarray,
     instead (tests/test_grad_sync.py).
     """
     names = tuple(axis_names)
-    delta = new_shard - old_shard
-    q, scale = _quantize_int8(delta, fused=fused)
-    gathered = lax.all_gather(q, names, axis=0, tiled=True)  # (padded,) s8
-    scales = lax.all_gather(scale[None], names, axis=0, tiled=True)
-    n = scales.shape[0]
-    full_delta = (gathered.reshape(n, -1).astype(jnp.float32)
-                  * scales[:, None]).reshape(-1)
+    full_delta = _s8_all_gather_dequant(new_shard - old_shard, names,
+                                        fused=fused)
     return old_flat + full_delta
+
+
+def quantized_shard_all_gather(shard: jnp.ndarray,
+                               axis_names: Sequence[str],
+                               fused: Optional[bool] = None) -> jnp.ndarray:
+    """Compressed explicit-FSDP PARAM all-gather: s8 codes of each
+    replica's shard (absolute values, one fp32 max-abs scale per chunk —
+    the per-destination-chunk rule again), gathered and dequantized
+    identically everywhere.
+
+    ``shard``: this replica's (chunk,) fp32 row of one layer group's
+    flat-padded parameters (at rest — explicit FSDP never holds a
+    replicated copy, so unlike zero1's `quantized_delta_all_gather` there
+    is no old_flat base to delta against; the codes carry the values
+    themselves). Returns the full (n x chunk,) fp32 reconstruction.
+
+    Error model (the hop-2 story applied to parameter VALUES, stated
+    honestly): every replica dequantizes the SAME (codes, scales), so the
+    gathered working parameters are exactly replica-identical; the at-rest
+    shards stay exact fp32 (only the per-step gathered copy is perturbed,
+    by <= scale/2 per element with scale = maxabs(chunk)/127 — coarser
+    than the delta gather's lr-sized error because it scales with the
+    PARAMETER magnitude, not the update). NOT error-fed-back (the same
+    one-owner/all-consumers argument); pinned by convergence tests, not
+    fp32 parity (tests/test_fsdp_explicit.py)."""
+    return _s8_all_gather_dequant(shard, tuple(axis_names), fused=fused)
 
 
 def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
@@ -567,6 +745,22 @@ def ef_state_bucketed(params: Any, mesh, n_shards: int,
              if wire_dtype == "int8_multihop" else plan.total_size)
     struct = jax.ShapeDtypeStruct((n_shards, total), jnp.float32)
     return {"ef": _born_sharded_zeros(struct, mesh)}
+
+
+def ef_state_fsdp(params: Any, mesh, n_shards: int):
+    """Per-replica residuals for the explicit-FSDP int8 gradient scatter:
+    one (n_shards, n_shards * row_size) fp32 array PER LAYER GROUP (the
+    scatter is per layer there — `build_layer_plan`), keyed by group name,
+    sharded over the batch axes so each replica materializes only its row.
+    The residual length is the group's full padded size: EF must remember
+    what was dropped from EVERY destination chunk, not just the kept one
+    (the `compressed_psum_scatter` convention)."""
+    plan = build_layer_plan(params, n_shards)
+    structs = {
+        g.name: jax.ShapeDtypeStruct(
+            (n_shards, n_shards * g.row_size), jnp.float32)
+        for g in plan.groups}
+    return {"ef": _born_sharded_zeros(structs, mesh)}
 
 
 def ef_state_zero1(params: Any, mesh, n_shards: int):

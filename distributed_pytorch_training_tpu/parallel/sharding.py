@@ -195,6 +195,29 @@ def dp_flat_specs(tree: Any, axes: Sequence[str] = BATCH_AXES) -> Any:
         lambda leaf: P(tuple(axes)) if np.ndim(leaf) else P(), tree)
 
 
+def fsdp_flat_params(params: Any, mesh: Mesh, n_shards: int) -> Any:
+    """Rewrite a (replicated, model-shaped) parameter tree into the
+    explicit-FSDP at-rest layout: every leaf flat-padded to a multiple of
+    ``n_shards`` and sharded 1/N over the batch axes — the zero1 moment
+    layout (`optim.zero1_opt_state`) applied to the PARAMETERS themselves.
+
+    Built under jit with ``out_shardings`` so XLA writes each replica's
+    chunk in place (the `_born_sharded_zeros` idiom: no full-tree flat
+    transient on one device). The original shapes/dtypes live on the
+    caller (Trainer keeps a ShapeDtypeStruct template for the per-layer
+    gather's unflatten)."""
+    specs = dp_flat_specs(jax.eval_shape(
+        lambda p: jax.tree_util.tree_map(
+            lambda x: flatten_pad(x, n_shards), p), params))
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs)
+    make = jax.jit(
+        lambda p: jax.tree_util.tree_map(
+            lambda x: flatten_pad(x, n_shards), p),
+        out_shardings=shardings)
+    return make(params)
+
+
 def batch_spec(ndim: int = 1) -> P:
     """Leading dim sharded over the batch axes (data, fsdp); rest replicated.
 

@@ -113,6 +113,12 @@ class CheckpointManager:
     mid-epoch preemption saves sort between epoch boundaries); the restored
     (epoch, step_in_epoch) pair tells the caller exactly where to resume.
 
+    Layout-agnostic: restore lands every array in the TEMPLATE's sharding,
+    so the flat-padded-sharded layouts (zero1's moments; fsdp_explicit's
+    params + moments + per-group EF residuals) round-trip exactly as the
+    replicated layout does — provided the template was built under the
+    same mesh and mode flags (train.py's resume hint names them).
+
     ``async_save=True`` (the default) makes ``save`` snapshot-then-write:
     device→host copy on the caller's thread, orbax write + manifest on a
     background writer (``save(..., wait=True)`` forces one save back to

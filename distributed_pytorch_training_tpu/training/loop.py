@@ -33,19 +33,23 @@ from typing import Any, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
 from ..parallel.grad_sync import (
-    EF_WIRE_DTYPES, WIRE_DTYPES, build_bucket_plan, compressed_psum_scatter,
-    ef_state_bucketed, ef_state_zero1, flatten_tree, padded_total_size,
-    quantized_delta_all_gather, reduce_flat, unflatten_tree,
+    EF_WIRE_DTYPES, WIRE_DTYPES, build_bucket_plan, build_layer_plan,
+    compressed_psum_scatter, ef_state_bucketed, ef_state_fsdp,
+    ef_state_zero1, flatten_tree, padded_total_size,
+    quantized_delta_all_gather, quantized_shard_all_gather, reduce_flat,
+    unflatten_tree,
 )
-from ..parallel.mesh import BATCH_AXES, batch_shard_count
+from ..parallel.mesh import BATCH_AXES, MODEL, batch_shard_count
 from ..parallel.sharding import (
-    PartitionRules, batch_spec, dp_flat_specs, flatten_pad, shard_pytree,
+    PartitionRules, batch_spec, dp_flat_specs, feasible_spec,
+    flatten_pad, fsdp_flat_params, shard_pytree, tree_specs,
 )
 from ..utils.logging import log_main
 from ..utils.metrics import ThroughputMeter
@@ -104,6 +108,25 @@ class TrainConfig:
     # per-step error, exactly replica-identical, not fed back;
     # grad_sync.quantized_delta_all_gather documents the model).
     wire_dtype: str = "fp32"
+    # Explicit full-parameter FSDP (SimpleFSDP, PAPERS.md): params AND
+    # optimizer moments live flat-sharded 1/N per replica AT REST (the
+    # zero1 flat padded layout applied to the parameters themselves), each
+    # layer's params are all-gathered just-in-time inside the shard_map'd
+    # step — gathers chained one layer ahead so layer i+1's gather can
+    # overlap layer i's compute — and gradients reduce-scatter directly
+    # back into the shard layout (compressed_psum_scatter, per layer).
+    # Parameter memory at rest divides by the batch-shard count; the
+    # transient in-step working set still peaks at full params (the
+    # gathered copies live through the backward), like zero1. Composes
+    # with wire_dtype: bf16/int8 compress the gradient scatter
+    # (int8 with error feedback, per layer group); "int8_multihop"
+    # additionally compresses the param gathers as s8 codes + per-chunk
+    # scales (grad_sync.quantized_shard_all_gather — bounded,
+    # replica-identical per-step perturbation of the gathered WORKING copy
+    # only; at-rest shards stay exact fp32). Incompatible with zero1 (this
+    # IS zero1 plus sharded params) and bucket_cap_mb (the per-layer cut
+    # owns the wire layout). Off = params replicated (DDP layout).
+    fsdp_explicit: bool = False
     # In grad-accum mode, reduce microbatch i's buckets INSIDE the scan
     # body (no data dependency on microbatch i+1's compute, so XLA can
     # overlap comm with compute — DDP's backward-hook overlap). False =
@@ -119,6 +142,33 @@ class TrainConfig:
     # parity-test configuration); False forces the XLA-composed reference.
     # A no-op unless wire_dtype is an int8 mode on a multi-shard mesh.
     fused_quantize: Optional[bool] = None
+
+
+def split_microbatches(tree: Any, accum: int,
+                       scope: str = "per-shard batch") -> Any:
+    """Interleaved microbatch split of a batch pytree for the grad-accum
+    scan: leading dim B -> (accum, B/accum, ...), microbatch i = rows
+    i::accum. INTERLEAVED, not contiguous blocks: the batch is sharded
+    over the data axes by contiguous row ranges, so a contiguous
+    microbatch would live on 1/accum of the devices and every scan step
+    would reshard; strided microbatches stay evenly spread over all
+    shards. Scalars broadcast to (accum,). One splitter for every step
+    mode (replicated / grad_sync / zero1 / fsdp — the four scan bodies
+    must agree on the interleaving or their parity tests lie); ``scope``
+    names the batch in the divisibility error ("global batch" on the
+    replicated path, the per-shard default inside shard_map bodies)."""
+
+    def split(x):
+        if x.ndim == 0:
+            return jnp.broadcast_to(x, (accum,))
+        if x.shape[0] % accum:
+            raise ValueError(
+                f"{scope} {x.shape[0]} not divisible by "
+                f"grad_accum={accum}")
+        return x.reshape(x.shape[0] // accum, accum,
+                         *x.shape[1:]).swapaxes(0, 1)
+
+    return jax.tree_util.tree_map(split, tree)
 
 
 class Trainer:
@@ -154,41 +204,97 @@ class Trainer:
                 "optimizer-state (and checkpoint) format — use zero1 with "
                 "wire_dtype compression, or the bucketed reducer without "
                 "zero1, not both")
+        if config.fsdp_explicit and config.zero1:
+            raise ValueError(
+                "fsdp_explicit IS zero1 plus flat-sharded parameters (the "
+                "sharded update with per-layer just-in-time gathers) — "
+                "pick one update mode, not both")
+        if config.fsdp_explicit and config.bucket_cap_mb > 0:
+            raise ValueError(
+                "bucket_cap_mb cuts the replicated reducer's flat "
+                "gradient; fsdp_explicit's wire layout is the per-layer "
+                "cut of the parameter tree (grad_sync.build_layer_plan) — "
+                "use fsdp_explicit with wire_dtype compression instead")
         explicit_sync = (config.bucket_cap_mb > 0
                          or config.wire_dtype != "fp32")
         self._zero1_n = batch_shard_count(mesh)
-        self._zero1 = bool(config.zero1) and self._zero1_n > 1
+        multi = self._zero1_n > 1
+        model_n = mesh.shape.get(MODEL, 1)
+        self._fsdp = bool(config.fsdp_explicit) and multi
+        # zero1 x TP (the per-leaf composition): on meshes with a model
+        # axis the manual shard_map path cannot run (the TP layers need
+        # GSPMD inside the body, and jax 0.4.x partial-auto shard_map
+        # rejects the collectives) — the update shards via per-leaf
+        # flat-padded sharding CONSTRAINTS instead: gradients/params are
+        # annotated P(batch axes) per leaf and GSPMD partitions the
+        # optimizer update + inserts the scatter/gather movement.
+        self._zero1_gspmd = bool(config.zero1) and multi and model_n > 1
+        self._zero1 = (bool(config.zero1) and multi
+                       and not self._zero1_gspmd)
         self._grad_sync = (explicit_sync and not config.zero1
-                           and self._zero1_n > 1)
-        if config.zero1 or explicit_sync:
-            # Both modes run the step in a shard_map over the batch axes
-            # with replicated parameters — same mesh constraints.
-            mode = "zero1" if config.zero1 else "grad_sync (bucket_cap_mb/" \
-                "wire_dtype)"
+                           and not config.fsdp_explicit and multi)
+        # the per-layer gather plan + unflatten template; built by
+        # init_state for fsdp_explicit states (the step needs the original
+        # shapes — flat leaves alone cannot be unflattened)
+        self._fsdp_plan = None
+        self._fsdp_template = None
+        self._fsdp_sizes = None
+        if config.zero1 or config.fsdp_explicit or explicit_sync:
+            # These modes run the step in a shard_map over the batch axes
+            # (zero1/grad_sync with replicated parameters, fsdp_explicit
+            # with flat-sharded ones) — same mesh constraints, except
+            # zero1 composes with a `model` axis via the GSPMD path above.
+            mode = ("fsdp_explicit" if config.fsdp_explicit
+                    else "zero1" if config.zero1
+                    else "grad_sync (bucket_cap_mb/wire_dtype)")
+            allowed = {MODEL} if config.zero1 else set()
             bad = sorted(a for a, s in mesh.shape.items()
-                         if s > 1 and a not in BATCH_AXES)
+                         if s > 1 and a not in BATCH_AXES
+                         and a not in allowed)
             if bad:
                 raise ValueError(
                     f"{mode} runs gradient sync over the data-parallel "
                     f"axes {BATCH_AXES}; mesh axes {bad} > 1 need the "
-                    "implicit path (TP/SP/PP/EP collectives are per-layer, "
-                    "not per-update)")
+                    "implicit path (SP/PP/EP collectives are per-layer, "
+                    "not per-update; only zero1 composes with a model "
+                    "axis, via the per-leaf GSPMD update)")
+            if self._zero1_gspmd and config.wire_dtype != "fp32":
+                raise ValueError(
+                    "zero1 on a model-axis mesh runs the GSPMD sharded "
+                    "update, where the scatter/gather are layout "
+                    "constraints, not explicit collectives — wire "
+                    "compression needs the manual shard_map path (a pure "
+                    "data-parallel mesh); use wire_dtype='fp32' here")
             if rules is not None:
                 conflict = sorted(
                     rules.axes_used()
                     & {a for a in BATCH_AXES if mesh.shape[a] > 1})
+                if conflict and config.fsdp_explicit:
+                    raise ValueError(
+                        "fsdp_explicit owns the parameter layout "
+                        "(flat-sharded 1/N over the batch axes) and would "
+                        f"silently drop the partition rules sharding "
+                        f"params over {conflict} — use GSPMD rules with "
+                        "the implicit path, or fsdp_explicit without "
+                        "param-sharding rules, not both")
                 if conflict:
                     raise ValueError(
                         f"{mode} assumes replicated parameters, but the "
                         f"partition rules shard params over {conflict} — "
-                        "use either the explicit update/sync modes "
-                        "(zero1/grad_sync) or fsdp parameter sharding on "
-                        "this mesh, not both")
-            if config.zero1 and not self._zero1:
+                        "explicitly sharded params + explicit sync is "
+                        "fsdp_explicit's job (TrainConfig.fsdp_explicit / "
+                        "--fsdp-explicit); GSPMD fsdp rules need the "
+                        "implicit path")
+            if config.zero1 and not multi:
                 log_main("NOTE: zero1 requested on a single batch shard — "
                          "running the replicated update (identity "
                          "passthrough, like single-process DDP)")
-            if not config.zero1 and explicit_sync and not self._grad_sync:
+            if config.fsdp_explicit and not multi:
+                log_main("NOTE: fsdp_explicit requested on a single batch "
+                         "shard — nothing to shard; running the "
+                         "replicated update (identity passthrough)")
+            if (not config.zero1 and not config.fsdp_explicit
+                    and explicit_sync and not self._grad_sync):
                 log_main("NOTE: explicit gradient sync requested on a "
                          "single batch shard — nothing to synchronize; "
                          "running the implicit path (identity passthrough, "
@@ -214,6 +320,8 @@ class Trainer:
         rng = jax.random.fold_in(epoch_key, state.step)
         accum = self.config.grad_accum
 
+        if self._fsdp:
+            return self._fsdp_step(state, batch, rng)
         if self._zero1:
             return self._zero1_step(state, batch, rng)
         if self._grad_sync:
@@ -229,6 +337,9 @@ class Trainer:
             # No explicit all-reduce: grads of a loss over the data-sharded
             # global batch are already the synchronized gradients (the DDP
             # reducer's job, ref :305-310, done by XLA layout propagation).
+            if self._zero1_gspmd:
+                return self._zero1_gspmd_apply(state, grads,
+                                               new_stats), metrics
             new_state = state.apply_gradients(grads, batch_stats=new_stats)
             return new_state, metrics
 
@@ -260,22 +371,8 @@ class Trainer:
         #   batch statistics — not `accum` compounding updates.
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
 
-        def split(x):
-            if x.ndim == 0:
-                return jnp.broadcast_to(x, (accum,))
-            if x.shape[0] % accum:
-                raise ValueError(
-                    f"global batch {x.shape[0]} not divisible by "
-                    f"grad_accum={accum}")
-            # INTERLEAVED split (microbatch i = rows i::accum), not
-            # contiguous blocks: the batch is sharded over the data axes by
-            # contiguous row ranges, so a contiguous microbatch would live
-            # on 1/accum of the devices and every scan step would reshard.
-            # Strided microbatches stay evenly spread over all shards.
-            return x.reshape(x.shape[0] // accum, accum,
-                             *x.shape[1:]).swapaxes(0, 1)
-
-        micro_batches = jax.tree_util.tree_map(split, batch)
+        micro_batches = split_microbatches(batch, accum,
+                                           scope="global batch")
 
         def micro_grads(mb, key):
             def loss_fn(params):
@@ -317,8 +414,62 @@ class Trainer:
                 s_sum, state.batch_stats)
         else:
             new_stats = state.batch_stats
+        if self._zero1_gspmd:
+            return self._zero1_gspmd_apply(state, grads, new_stats), metrics
         new_state = state.apply_gradients(grads, batch_stats=new_stats)
         return new_state, metrics
+
+    # -- ZeRO-1 x TP: GSPMD-sharded weight update ----------------------------
+
+    def _zero1_gspmd_apply(self, state: TrainState, grads, new_stats
+                           ) -> TrainState:
+        """The zero1 update on meshes with a `model` axis (the per-leaf
+        composition): gradients arrive fully synchronized from the
+        replicated path's implicit sync (TP params carry TP-sharded grads,
+        DP sync is XLA's), and the UPDATE shards over the batch axes by
+        layout constraint — each leaf's gradient, parameter view, and
+        moments are flat-padded and annotated P(batch axes), so GSPMD
+        partitions the elementwise optimizer chain 1/N per replica and
+        inserts the scatter/gather data movement itself. Moments live
+        flat-sharded from init (`optim.zero1_opt_state`), exactly like the
+        manual zero1 path — same checkpoint layout, same memory division.
+
+        Trade-offs vs the manual shard_map path (pure-DP meshes), stated
+        honestly: the collective schedule is XLA's choice (no
+        reduce-scatter signature contract), wire compression is
+        unavailable (the scatter/gather are constraints, not explicit
+        collectives the codecs could wrap), and the global-norm clip runs
+        on GLOBAL flat arrays (stock optax — build the optimizer with
+        shard_axes=None). Parity vs the replicated update is pinned at
+        reassociation tolerance in tests/test_zero1.py."""
+        from jax.sharding import NamedSharding
+
+        mesh, n = self.mesh, self._zero1_n
+        dp = NamedSharding(mesh, P(BATCH_AXES))
+
+        def flat_dp(x):
+            return lax.with_sharding_constraint(
+                flatten_pad(x.astype(jnp.float32), n), dp)
+
+        flat_g = jax.tree_util.tree_map(flat_dp, grads)
+        p_flat = jax.tree_util.tree_map(flat_dp, state.params)
+        updates, new_opt = state.tx.update(flat_g, state.opt_state, p_flat)
+        new_flat = optax.apply_updates(p_flat, updates)
+        # back to model shapes, re-constrained to the rules' layout so the
+        # updated params keep their TP sharding instead of whatever the
+        # flat->full reshape propagates
+        specs = tree_specs(state.params, self.rules)
+
+        def unflatten(f, p, spec):
+            full = f[:p.size].reshape(p.shape).astype(p.dtype)
+            return lax.with_sharding_constraint(
+                full, NamedSharding(
+                    mesh, feasible_spec(spec, p.shape, mesh)))
+
+        new_params = jax.tree_util.tree_map(unflatten, new_flat,
+                                            state.params, specs)
+        return state.replace(step=state.step + 1, params=new_params,
+                             batch_stats=new_stats, opt_state=new_opt)
 
     # -- explicit bucketed / compressed gradient sync ------------------------
 
@@ -427,17 +578,7 @@ class Trainer:
                 # the replicated path's interleaved LOCAL split (zero1's
                 # argument verbatim: local rows i::accum are the shard's
                 # part of global microbatch i)
-                def split(x):
-                    if x.ndim == 0:
-                        return jnp.broadcast_to(x, (accum,))
-                    if x.shape[0] % accum:
-                        raise ValueError(
-                            f"per-shard batch {x.shape[0]} not divisible "
-                            f"by grad_accum={accum}")
-                    return x.reshape(x.shape[0] // accum, accum,
-                                     *x.shape[1:]).swapaxes(0, 1)
-
-                micro_batches = jax.tree_util.tree_map(split, lbatch)
+                micro_batches = split_microbatches(lbatch, accum)
                 keys = jax.random.split(key, accum)
 
                 def mb_body(carry, xs):
@@ -633,17 +774,7 @@ class Trainer:
                 # divisible by accum, local rows i::accum are exactly the
                 # shard's part of global microbatch i (the interleaved
                 # global split of the replicated path).
-                def split(x):
-                    if x.ndim == 0:
-                        return jnp.broadcast_to(x, (accum,))
-                    if x.shape[0] % accum:
-                        raise ValueError(
-                            f"per-shard batch {x.shape[0]} not divisible "
-                            f"by grad_accum={accum}")
-                    return x.reshape(x.shape[0] // accum, accum,
-                                     *x.shape[1:]).swapaxes(0, 1)
-
-                micro_batches = jax.tree_util.tree_map(split, lbatch)
+                micro_batches = split_microbatches(lbatch, accum)
                 keys = jax.random.split(key, accum)
 
                 def mb_body(carry, xs):
@@ -739,10 +870,262 @@ class Trainer:
                                   grad_sync=new_gs)
         return new_state, metrics
 
+    # -- explicit full-parameter FSDP ---------------------------------------
+
+    def _fsdp_unflatten(self, flat_params):
+        """Model-shaped params from the flat-sharded at-rest layout via
+        plain reshape/slice ops — OUTSIDE shard_map (eval, diagnostics)
+        GSPMD inserts the gathers; inside the step the per-layer gather
+        does it explicitly."""
+        if self._fsdp_template is None:
+            raise ValueError(
+                "fsdp_explicit state has no unflatten template — build "
+                "the state via Trainer.init_state (the flat leaves alone "
+                "cannot recover the model shapes)")
+        return jax.tree_util.tree_map(
+            lambda f, t: f[:int(np.prod(t.shape) or 1)]
+            .reshape(t.shape).astype(t.dtype),
+            flat_params, self._fsdp_template)
+
+    def _fsdp_step(self, state: TrainState, batch, rng):
+        """Explicit full-parameter FSDP (SimpleFSDP, PAPERS.md): params and
+        moments live flat-sharded 1/N at rest; the step, inside one
+        shard_map over the batch axes, (1) rebuilds the full parameters
+        with ONE all-gather per layer group — gathers chained one layer
+        ahead via `lax.optimization_barrier`, so gather i+1 waits only on
+        gather i (not on any compute) and the scheduler can run it under
+        layer i's consumption — (2) computes this replica's local
+        gradients against the gathered working copy, (3) reduce-scatters
+        each layer's gradient straight into the shard layout
+        (`compressed_psum_scatter` on the destination-major group row
+        stacking), and (4) updates 1/N of params+moments per replica. The
+        new param SHARDS are the step's output — nothing gathers back to
+        replicated; the next step's forward re-gathers just-in-time.
+
+        Equivalence scope vs the replicated path, same batch: the zero1
+        semantics verbatim (the update pipeline is zero1's with the gather
+        moved from epilogue to prologue) — fp32 parity at reassociation
+        tolerance, per-shard RNG folds, per-shard BatchNorm statistics.
+        Wire modes: bf16/int8 compress the scatter only (int8 with
+        per-group error feedback; gathers stay exact fp32, like zero1's);
+        "int8_multihop" also compresses the param gathers
+        (`quantized_shard_all_gather`: bounded, replica-identical
+        perturbation of the gathered WORKING copy — the at-rest shards
+        stay exact, so the error does not accumulate into the stored
+        parameters; convergence pinned, not parity).
+        """
+        mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
+        axes = BATCH_AXES
+        task, cfg = self.task, self.config
+        wire = cfg.wire_dtype
+        fusedq = cfg.fused_quantize  # tri-state, resolved at trace
+        scatter_wire = "int8" if wire == "int8_multihop" else wire
+        use_ef = wire in EF_WIRE_DTYPES
+        plan = self._fsdp_plan
+        if plan is None:
+            raise ValueError(
+                "fsdp_explicit needs the per-layer plan and unflatten "
+                "template — build the state via Trainer.init_state")
+        if use_ef and not state.grad_sync:
+            raise ValueError(
+                f"wire_dtype={wire!r} needs error-feedback buffers — build "
+                "the state via Trainer.init_state (TrainState.grad_sync is "
+                "empty)")
+        if use_ef:
+            for g in plan.groups:
+                got = state.grad_sync["ef"][g.name].shape[-1]
+                expect = n * g.row_size
+                if got != expect:
+                    raise ValueError(
+                        f"error-feedback residual for layer group "
+                        f"{g.name!r} has {got} elements, expected {expect} "
+                        "— the state was built for a different model/mesh; "
+                        "rebuild via Trainer.init_state")
+        has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
+        outer = state  # static fields (apply_fn/tx) for the inner rebuild
+        template_leaves = jax.tree_util.tree_leaves(self._fsdp_template)
+        treedef = jax.tree_util.tree_structure(self._fsdp_template)
+        leaf_sizes = self._fsdp_sizes  # host-precomputed (init_state)
+
+        rep = P()
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: batch_spec(jnp.ndim(x)), batch)
+        param_specs = dp_flat_specs(state.params)
+        opt_specs = dp_flat_specs(state.opt_state)
+
+        def body(p_shards, opt_state, stats, lbatch, key, step, *maybe_ef):
+            idx = lax.axis_index(axes)
+            # per-group residuals, (1, G) local row -> (G,)
+            ef_l = ({name: r[0] for name, r in maybe_ef[0].items()}
+                    if use_ef else None)
+            shard_leaves = treedef.flatten_up_to(p_shards)
+
+            # -- per-layer just-in-time gather (the prologue) -------------
+            full = [None] * len(template_leaves)
+            prev = None
+            for g in plan.groups:
+                row = (jnp.concatenate([shard_leaves[s].astype(jnp.float32)
+                                        for s in g.leaf_slots])
+                       if len(g.leaf_slots) > 1
+                       else shard_leaves[g.leaf_slots[0]]
+                       .astype(jnp.float32))
+                if prev is not None:
+                    # prefetch chain: gather i+1 depends on gather i's
+                    # COMPLETION only — never on layer i's compute — so
+                    # the latency-hiding scheduler can issue it while
+                    # layer i is being consumed, one layer ahead
+                    row = lax.optimization_barrier((row, prev))[0]
+                if wire == "int8_multihop":
+                    flatg = quantized_shard_all_gather(row, axes,
+                                                       fused=fusedq)
+                else:
+                    flatg = all_gather(row, axes)
+                prev = flatg
+                mat = flatg.reshape(n, g.row_size)
+                off = 0
+                for s, c in zip(g.leaf_slots, g.chunk_sizes):
+                    t = template_leaves[s]
+                    full[s] = (mat[:, off:off + c].reshape(-1)
+                               [:leaf_sizes[s]]
+                               .reshape(t.shape).astype(t.dtype))
+                    off += c
+            params = jax.tree_util.tree_unflatten(treedef, full)
+            inner = outer.replace(step=step, params=params,
+                                  batch_stats=stats, opt_state=opt_state)
+
+            def micro_grads(mb, k):
+                def loss_fn(p):
+                    return task.loss_and_metrics(inner, p, mb, k, train=True)
+
+                return jax.grad(loss_fn, has_aux=True)(params)
+
+            def scatter_layers(gtree, ef_tree, into=None):
+                """Per-layer compressed reduce-scatter of the w-scaled
+                grad tree straight into the shard layout: returns
+                (per-leaf chunk tree [+= into], new per-group ef dict)."""
+                g_leaves = treedef.flatten_up_to(gtree)
+                into_leaves = (treedef.flatten_up_to(into)
+                               if into is not None else None)
+                outs = [None] * len(g_leaves)
+                new_ef = {}
+                for g in plan.groups:
+                    # destination-major stacking: row j = concat of every
+                    # member leaf's chunk j, so the scatter lands each
+                    # leaf's chunk on its owner in one collective
+                    parts = [
+                        flatten_pad(g_leaves[s].astype(jnp.float32), n)
+                        .reshape(n, -1)
+                        for s in g.leaf_slots]
+                    v = (jnp.concatenate(parts, axis=1)
+                         if len(parts) > 1 else parts[0]).reshape(-1)
+                    r = ef_tree[g.name] if use_ef else None
+                    s_out, nr = compressed_psum_scatter(
+                        v, axes, n, scatter_wire, r, fused=fusedq)
+                    off = 0
+                    for s, c in zip(g.leaf_slots, g.chunk_sizes):
+                        chunk = lax.slice_in_dim(s_out, off, off + c)
+                        outs[s] = (into_leaves[s] + chunk
+                                   if into is not None else chunk)
+                        off += c
+                    if use_ef:
+                        new_ef[g.name] = nr
+                return (jax.tree_util.tree_unflatten(treedef, outs),
+                        new_ef if use_ef else None)
+
+            if accum <= 1:
+                key = jax.random.fold_in(key, idx)
+                g, (m, stats_l) = micro_grads(lbatch, key)
+                w = m["weight"]
+                g_sum, ef_l = scatter_layers(
+                    jax.tree_util.tree_map(lambda a: w * a, g), ef_l)
+                s_sum = (jax.tree_util.tree_map(
+                    lambda s: w * s.astype(jnp.float32), stats_l)
+                    if has_stats else stats)
+                m_local = m
+            else:
+                # zero1's in-scan accumulation verbatim: the carry holds
+                # per-leaf gradient SHARDS (1/N the replicated buffer),
+                # and each microbatch's scatter overlaps the next
+                # microbatch's compute
+                micro_batches = split_microbatches(lbatch, accum)
+                keys = jax.random.split(key, accum)
+
+                def mb_body(carry, xs):
+                    g_sum, s_sum, m_sum, ef_c = carry
+                    mb, k = xs
+                    g, (m, stats_mb) = micro_grads(
+                        mb, jax.random.fold_in(k, idx))
+                    w = m["weight"]
+                    g_sum, ef_c = scatter_layers(
+                        jax.tree_util.tree_map(lambda b: w * b, g), ef_c,
+                        into=g_sum)
+                    if has_stats:
+                        s_sum = jax.tree_util.tree_map(
+                            lambda a, b: a + w * b.astype(a.dtype),
+                            s_sum, stats_mb)
+                    m_sum = add_metrics(m_sum, m)
+                    return (g_sum, s_sum, m_sum, ef_c), None
+
+                g0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), p_shards)
+                s0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), stats)
+                (g_sum, s_sum, m_local, ef_l), _ = lax.scan(
+                    mb_body, (g0, s0, zero_metrics(), ef_l),
+                    (micro_batches, keys))
+
+            metrics = jax.tree_util.tree_map(
+                lambda v: psum(v, axes), m_local)
+            total_w = jnp.maximum(metrics["weight"], 1.0)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g / total_w).astype(p.dtype), g_sum, p_shards)
+
+            # 1/N of the optimizer update, on the at-rest shards — the
+            # zero1 core, minus its epilogue gather: the new shards ARE
+            # the output layout
+            updates, new_opt = outer.tx.update(grads, opt_state, p_shards)
+            new_p_shards = optax.apply_updates(p_shards, updates)
+
+            if has_stats:
+                new_stats = jax.tree_util.tree_map(
+                    lambda s, old: jnp.where(
+                        metrics["weight"] > 0,
+                        psum(s, axes) / total_w,
+                        old.astype(jnp.float32)).astype(old.dtype),
+                    s_sum, stats)
+            else:
+                new_stats = stats
+            out = (new_p_shards, new_opt, new_stats, metrics)
+            if use_ef:
+                out += ({name: r[None] for name, r in ef_l.items()},)
+            return out
+
+        in_specs = (param_specs, opt_specs, rep, batch_specs, rep, rep)
+        out_specs = (param_specs, opt_specs, rep, rep)
+        args = [state.params, state.opt_state, state.batch_stats, batch,
+                rng, state.step]
+        if use_ef:
+            ef_specs = jax.tree_util.tree_map(lambda _: P(axes),
+                                              state.grad_sync["ef"])
+            in_specs += (ef_specs,)
+            out_specs += (ef_specs,)
+            args.append(state.grad_sync["ef"])
+        stepped = shard_map(body, mesh, in_specs=in_specs,
+                            out_specs=out_specs)
+        res = stepped(*args)
+        new_params, new_opt, new_stats, metrics = res[:4]
+        new_gs = {"ef": res[4]} if use_ef else state.grad_sync
+        new_state = state.replace(step=state.step + 1, params=new_params,
+                                  batch_stats=new_stats, opt_state=new_opt,
+                                  grad_sync=new_gs)
+        return new_state, metrics
+
     def _eval_step_impl(self, state: TrainState, batch):
         rng = jax.random.PRNGKey(0)  # unused: eval has no augmentation (ref :98-101)
+        params = (self._fsdp_unflatten(state.params) if self._fsdp
+                  else state.params)
         _, (metrics, _) = self.task.loss_and_metrics(
-            state, state.params, batch, rng, train=False)
+            state, params, batch, rng, train=False)
         return metrics
 
     # -- state construction ------------------------------------------------
@@ -769,8 +1152,38 @@ class Trainer:
         # its scatter half under both int8 forms ("int8_multihop" scatters
         # via the same s8 all-to-all; only its param gather differs).
         use_ef = (self.config.wire_dtype in EF_WIRE_DTYPES
-                  and (self._zero1 or self._grad_sync))
-        if self._zero1:
+                  and (self._zero1 or self._grad_sync or self._fsdp))
+        if self._fsdp:
+            # Explicit FSDP: params AND moments are born in the zero1 flat
+            # padded layout, 1/N per replica at rest — the at-rest memory
+            # division that is the mode's point. The model-shaped template
+            # (shapes/dtypes only, host-side) is what the step's per-layer
+            # gather unflattens against.
+            from .optim import zero1_opt_state
+
+            self._fsdp_template = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(jnp.shape(p),
+                                               jnp.result_type(p)), params)
+            # host-side leaf sizes (tree_leaves order) for the in-step
+            # unflatten slicing — precomputed here so the traced step does
+            # no int() shape math (the no-host-sync-in-step lint's scope)
+            self._fsdp_sizes = tuple(
+                int(np.prod(t.shape) or 1) for t in
+                jax.tree_util.tree_leaves(self._fsdp_template))
+            self._fsdp_plan = build_layer_plan(params, self._zero1_n)
+            opt_state = zero1_opt_state(tx, params, self.mesh)
+            flat_params = fsdp_flat_params(params, self.mesh, self._zero1_n)
+            state = TrainState.create(
+                apply_fn=model.apply, params=params, tx=tx,
+                batch_stats=batch_stats, opt_state=opt_state)
+            placed = shard_pytree(state.replace(params={}, opt_state={}),
+                                  self.mesh, None)
+            placed = placed.replace(params=flat_params, opt_state=opt_state)
+            if use_ef:
+                placed = placed.replace(grad_sync=ef_state_fsdp(
+                    params, self.mesh, self._zero1_n))
+            return placed
+        if self._zero1 or self._zero1_gspmd:
             # Params stay replicated (the DDP layout — zero1 shards only
             # the UPDATE); the optimizer state is born flat-padded-sharded
             # over the batch axes, 1/N per replica.

@@ -147,11 +147,16 @@ def make_optimizer(
 
 def zero1_opt_state(tx: optax.GradientTransformation, params,
                     mesh) -> "tuple":
-    """Optimizer state for the ZeRO-1 sharded update: moments are born in
+    """Optimizer state for the sharded weight update: moments are born in
     the flat-padded-sharded layout (parallel/sharding.py `flatten_pad`),
     each replica materializing ONLY its 1/N chunk — the optimizer-memory
     division that motivates cross-replica weight-update sharding (Xu et
     al., PAPERS.md). Scalar state (step counts) stays replicated.
+
+    Used by every mode that updates 1/N of the weights per replica: the
+    manual zero1 shard_map path, the zero1 x TP GSPMD composition, and
+    explicit FSDP (`fsdp_explicit`, which additionally stores the PARAMS
+    in the same flat layout — parallel/sharding.py `fsdp_flat_params`).
     """
     import jax
     from jax.sharding import NamedSharding

@@ -26,8 +26,10 @@ class TrainState:
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
     # Explicit-reducer side state (parallel/grad_sync.py): error-feedback
     # residuals for the int8 gradient wire ({"ef": ...}, per-replica rows
-    # sharded over the batch axes). {} (no leaves) for every other mode —
-    # the pytree/checkpoint shape is unchanged unless int8 is engaged.
+    # sharded over the batch axes — keyed per bucket/leaf for the bucketed
+    # and zero1 scatters, per LAYER GROUP name for fsdp_explicit's
+    # per-layer scatter). {} (no leaves) for every other mode — the
+    # pytree/checkpoint shape is unchanged unless int8 is engaged.
     grad_sync: Any = dataclasses.field(default_factory=dict)
 
     @classmethod

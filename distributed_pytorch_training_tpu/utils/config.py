@@ -126,13 +126,37 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "scan instead of inside it (exposes the "
                              "communication; for measuring the overlap "
                              "win)")
+    parser.add_argument("--fsdp-explicit", action="store_true",
+                        help="explicit full-parameter FSDP (SimpleFSDP): "
+                             "params AND optimizer moments live flat-"
+                             "sharded 1/N per replica at rest; each layer's "
+                             "params are all-gathered just-in-time inside "
+                             "the step (one collective per layer group, "
+                             "chained one layer ahead so gathers overlap "
+                             "compute) and gradients reduce-scatter "
+                             "straight back into the shard layout. "
+                             "Parameter memory at rest divides by the "
+                             "data-parallel degree — the mode that unlocks "
+                             "models whose replicated params+moments "
+                             "don't fit one device. Composes with "
+                             "--wire-dtype (bf16/int8 compress the "
+                             "gradient scatter; int8_multihop also "
+                             "compresses the param gathers as s8 codes + "
+                             "per-chunk scales). Incompatible with --zero1 "
+                             "(this IS zero1 plus sharded params) and "
+                             "--bucket-cap-mb (the per-layer cut owns the "
+                             "wire layout)")
     parser.add_argument("--zero1", action="store_true",
                         help="ZeRO-1 cross-replica weight-update sharding "
                              "for data-parallel meshes: reduce-scatter "
                              "gradients, update 1/N of the params + "
                              "optimizer state per replica, all-gather the "
                              "new params — optimizer compute/memory / N. "
-                             "Default off (replicated DDP-style update)")
+                             "Default off (replicated DDP-style update). "
+                             "On meshes with a model axis the update "
+                             "shards per-leaf via GSPMD constraints "
+                             "instead of the manual shard_map (fp32 wire "
+                             "only there)")
     parser.add_argument("--remat", action="store_true",
                         help="gradient checkpointing: recompute each "
                              "transformer block in the backward pass "

@@ -10,9 +10,10 @@ from distributed_pytorch_training_tpu.analysis.__main__ import main
 
 def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     """THE acceptance test: every AST rule over the repo plus every HLO
-    contract in the matrix (dp / zero1 / grad_sync x wires / accum),
-    lowered on the 8-device CPU mesh — clean, and every contract really
-    evaluated (a matrix of skips would be vacuously green)."""
+    contract in the matrix (dp / zero1 / grad_sync x wires / accum /
+    explicit FSDP), lowered on the 8-device CPU mesh — clean, and every
+    contract really evaluated (a matrix of skips would be vacuously
+    green)."""
     assert main(["check", "--json"]) == 0
     report = json.loads(capsys.readouterr().out)
     assert report["ok"] is True and report["findings"] == []
@@ -21,11 +22,13 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "zero1_int8_mh",
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
                              "gsync_bf16_accum", "gsync_int8_mh",
-                             "gsync_int8_mh_accum", "gsync_int8_mh_fused"}
+                             "gsync_int8_mh_accum", "gsync_int8_mh_fused",
+                             "fsdp", "fsdp_accum", "fsdp_int8_mh"}
     assert all(s == "pass" for s in statuses.values()), statuses
-    # both engines actually ran
+    # both engines actually ran, incl. the fsdp rules (ISSUE 7)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
+    assert "fsdp-layer-gather-bound" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

@@ -466,3 +466,142 @@ class TestMultihopBound:
             assert c.config.get("grad_accum", 1) == accum
             assert c.min_shards == 2
             assert c.config["bucket_cap_mb"] > 0
+
+
+class TestFsdpRules:
+    """Mutation tests for the explicit-FSDP rules (ISSUE 7): per-layer
+    gather bound, scatter-into-shard signature, no full-param residency.
+    Expectations are FLOOR-AWARE: the budget is the per-group padded sizes
+    (layer_group_padded_sizes), and a group whose collective result falls
+    under min_elements is invisible to the census by design — a gather
+    result carries the full padded group, a plain reduce-scatter result
+    only the 1/N destination chunk, the s8 all-to-all the full group."""
+
+    CFG = dict(fsdp_explicit=True)
+    SIZES = (65536, 65536)  # both >= the 8192 floor; rs result 8192 each
+    AG = ["  %ag.{i} = f32[65536]{{0}} all-gather(f32[8192]{{0}} %p.{i})"
+          .format(i=i) for i in range(2)]
+    RS = ["  %rs.{i} = f32[8192]{{0}} reduce-scatter(f32[65536]{{0}} %g.{i})"
+          .format(i=i) for i in range(2)]
+
+    def test_mutation_missing_budget_flags(self):
+        a = _artifacts(self.AG + self.RS, config=self.CFG)
+        found = _run(a, "fsdp-layer-gather-bound")
+        assert found and "budget" in found[0].message
+
+    def test_mutation_missing_or_extra_gather_flags(self):
+        a = _artifacts(self.AG[:1] + self.RS, config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES)
+        assert _run(a, "fsdp-layer-gather-bound")
+        a = _artifacts(self.AG + self.AG + self.RS, config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES)
+        assert _run(a, "fsdp-layer-gather-bound")
+
+    def test_gather_expectation_is_floor_aware(self):
+        """A sub-floor group (the tiny final layernorm) must NOT be
+        demanded from the census — 2 visible gathers against 3 groups of
+        which one is under the floor is clean."""
+        a = _artifacts(self.AG + self.RS, config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES + (4096,))
+        assert _run(a, "fsdp-layer-gather-bound") == []
+        assert _run(a, "fsdp-scatter-into-shard") == []
+
+    def test_mutation_missing_scatter_flags(self):
+        a = _artifacts(self.AG + self.RS[:1], config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES)
+        assert _run(a, "fsdp-scatter-into-shard")
+
+    def test_mutation_surviving_all_reduce_flags(self):
+        """A gradient-sized all-reduce means replicated gradient sync —
+        the at-rest sharding would be cosmetic."""
+        a = _artifacts(self.AG + self.RS + [big_allreduce()],
+                       config=self.CFG, layer_group_padded_sizes=self.SIZES)
+        found = _run(a, "fsdp-scatter-into-shard")
+        assert found and any("all-reduce" in f.message for f in found)
+
+    def test_scatter_expectation_follows_wire(self):
+        """fp32: a group is scatter-visible only if its 1/N chunk clears
+        the floor (65536//8 = 8192 yes, 16384//8 = 2048 no). int8: the s8
+        all-to-all carries the FULL group, so the gather-visibility rule
+        applies to both directions."""
+        a = _artifacts(self.AG + ["  %ag.2 = f32[16384]{0} all-gather("
+                                  "f32[2048]{0} %p.2)"] + self.RS,
+                       config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES + (16384,))
+        assert _run(a, "fsdp-layer-gather-bound") == []
+        assert _run(a, "fsdp-scatter-into-shard") == []
+        a2a = ["  %c.{i} = s8[65536]{{0}} all-to-all(s8[65536]{{0}} %q.{i})"
+               .format(i=i) for i in range(2)]
+        ag8 = ["  %ag.{i} = s8[65536]{{0}} all-gather(s8[8192]{{0}} %p.{i})"
+               .format(i=i) for i in range(2)]
+        a = _artifacts(ag8 + a2a,
+                       config=dict(fsdp_explicit=True,
+                                   wire_dtype="int8_multihop"),
+                       layer_group_padded_sizes=self.SIZES)
+        assert _run(a, "fsdp-layer-gather-bound") == []
+        assert _run(a, "fsdp-scatter-into-shard") == []
+
+    def test_mutation_replicated_param_buffer_flags(self):
+        a = _artifacts(self.AG + self.RS, config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES,
+                       replicated_param_buffers=(
+                           ("['wte']['embedding']", 65536),))
+        found = _run(a, "fsdp-no-full-param-residency")
+        assert found and "wte" in found[0].message
+
+    def test_mutation_replicated_entry_param_flags(self):
+        """The lowered-module read: a compiled step taking a param-sized
+        REPLICATED entry operand pays full residency whatever the live
+        state claims."""
+        leak = ("  %arg0.1 = f32[65536]{0} parameter(0), "
+                "sharding={replicated}")
+        a = _artifacts(self.AG + self.RS + [leak], config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES)
+        found = _run(a, "fsdp-no-full-param-residency")
+        assert found and "entry" in found[0].message
+        # sharded entry params and sub-floor replicated scalars are clean
+        ok = ("  %arg0.1 = f32[8192]{0} parameter(0), "
+              "sharding={devices=[8]<=[8]}")
+        scal = "  %arg1.1 = f32[] parameter(1), sharding={replicated}"
+        a = _artifacts(self.AG + self.RS + [ok, scal], config=self.CFG,
+                       layer_group_padded_sizes=self.SIZES)
+        assert _run(a, "fsdp-no-full-param-residency") == []
+
+    def test_not_engaged_skips(self):
+        a = _artifacts([], config=dict(fsdp_explicit=True), n_shards=1)
+        for rule in ("fsdp-layer-gather-bound", "fsdp-scatter-into-shard",
+                     "fsdp-no-full-param-residency"):
+            assert _run(a, rule) == []
+
+    def test_fsdp_evaluation_reads_real_shardings(self, mesh8):
+        """Integration: on a real fsdp state the evaluator's sharding read
+        finds NO replicated param buffer; on the replicated (dp) state it
+        finds them all — the residency rule's input is live data."""
+        from distributed_pytorch_training_tpu.analysis.contracts import (
+            get_contract,
+        )
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            _tiny_lm_setup, replicated_large_buffers,
+        )
+
+        _, state_dp, _ = _tiny_lm_setup(mesh8, {})
+        assert replicated_large_buffers(state_dp.params, 128)
+        _, state_fs, _ = _tiny_lm_setup(mesh8, get_contract("fsdp").config)
+        assert replicated_large_buffers(state_fs.params, 128) == ()
+
+    def test_fsdp_contracts_in_matrix(self):
+        """The canonical matrix carries the fsdp configs (tier-1 gates the
+        mode end to end, not just this file's synthetics)."""
+        from distributed_pytorch_training_tpu.analysis.contracts import (
+            get_contract,
+        )
+
+        for name, wire, accum in (("fsdp", "fp32", 1),
+                                  ("fsdp_accum", "fp32", 2),
+                                  ("fsdp_int8_mh", "int8_multihop", 1)):
+            c = get_contract(name)
+            assert c.config["fsdp_explicit"] is True
+            assert c.config.get("wire_dtype", "fp32") == wire
+            assert c.config.get("grad_accum", 1) == accum
+            assert c.min_shards == 2
+            assert "bucket_cap_mb" not in c.config

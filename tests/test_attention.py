@@ -391,7 +391,10 @@ class TestRingFlashFused:
             for eqn in jaxpr.eqns:
                 if eqn.primitive.name == "pallas_call":
                     n += 1
-                for key in ("jaxpr", "call_jaxpr"):
+                # fun_jaxpr: custom_vjp_call_jaxpr's body param on jax
+                # 0.4.x — without it the fused ring's kernels (inside the
+                # _ring_flash custom_vjp) are invisible to this census
+                for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
                     sub = eqn.params.get(key) if eqn.params else None
                     if sub is not None:
                         n += count_pallas(getattr(sub, "jaxpr", sub))

@@ -122,6 +122,20 @@ def test_experiment_grad_sync_smoke(capsys):
     assert "exposed_comm_pct" in out
 
 
+@pytest.mark.slow
+def test_experiment_fsdp_smoke(capsys):
+    """The explicit-FSDP arm (ISSUE 7): replicated-vs-fsdp rows with the
+    per-layer collective census, at-rest residency division and the
+    fsdp_gather_bytes wire term."""
+    _run_experiment(["fsdp", "--model", "gpt2_124m", "--lm-tiny",
+                     "--seq-len", "32"] + _SMOKE)
+    out = capsys.readouterr().out
+    assert "fsdp_fp32" in out and "fsdp_int8_multihop" in out
+    assert "param_bytes_at_rest_per_replica" in out
+    assert "fsdp_gather_bytes" in out
+    assert "all_gathers" in out
+
+
 def test_comm_overlap_split_math(tmp_path):
     """Interval arithmetic of the exposed-vs-hidden split on a synthetic
     trace: one collective fully covered by compute, one half covered, one

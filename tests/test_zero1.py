@@ -259,12 +259,63 @@ def test_zero1_single_shard_passthrough_via_harness_adamw(devices):
     assert np.isfinite(float(m["loss_sum"]))
 
 
-def test_zero1_rejects_non_dp_meshes(devices):
-    """TP/SP/PP/EP axes need the replicated update; a zero1 request there
-    must fail loudly at construction, not silently mis-shard."""
-    mesh = build_mesh(MeshSpec(data=4, model=2), devices=devices)
+def test_zero1_rejects_non_dp_non_model_meshes(devices):
+    """SP/PP/EP axes need the replicated update; a zero1 request there must
+    fail loudly at construction, not silently mis-shard. (A `model` axis is
+    the exception since ISSUE 7: zero1 composes with TP via the per-leaf
+    GSPMD update — test_zero1_tp_* below.)"""
+    mesh = build_mesh(MeshSpec(data=2, seq=2, model=2), devices=devices)
     with pytest.raises(ValueError, match="zero1"):
         Trainer(LanguageModelingTask(), mesh, TrainConfig(zero1=True))
+
+
+def test_zero1_tp_gspmd_matches_replicated(devices):
+    """zero1 x TP (the ISSUE 7 satellite): on a mesh with a model axis the
+    update shards per-leaf via GSPMD flat-padded sharding constraints
+    (training/loop.py _zero1_gspmd_apply) instead of the manual shard_map —
+    and the trajectory must match the replicated update exactly (same
+    gradients, same optimizer math, different layout)."""
+    mesh_tp = build_mesh(MeshSpec(data=4, model=2), devices=devices)
+    batch = _batch(mesh_tp)
+    key = jax.random.PRNGKey(1)
+    out = {}
+    for zero1 in (False, True):
+        t = Trainer(LanguageModelingTask(compute_dtype=jnp.float32),
+                    mesh_tp, TrainConfig(seed=0, zero1=zero1),
+                    rules=GPT2LMHead.partition_rules())
+        assert t._zero1_gspmd == zero1  # per-leaf path, not the manual one
+        assert not t._zero1
+        # stock clip: the GSPMD update runs on GLOBAL flat arrays
+        s = t.init_state(_tiny_gpt2(), np.zeros((1, SEQ), np.int32),
+                         _make_tx("sgd"), jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(4):
+            s, m = t._train_step(s, batch, key)
+            losses.append(float(m["loss_sum"]) / float(m["weight"]))
+        out[zero1] = (losses, s)
+    np.testing.assert_allclose(out[False][0], out[True][0], rtol=2e-5)
+    _assert_params_close(out[False][1], out[True][1], rtol=1e-4, atol=1e-6)
+    # moments born flat-sharded over the batch axes (1/4 per replica here):
+    # every non-scalar optimizer leaf is 1-D flat-padded and NOT replicated
+    n_checked = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+            out[True][1].opt_state):
+        if getattr(leaf, "ndim", 0) >= 1 and leaf.size >= 8:
+            assert leaf.ndim == 1, (path, leaf.shape)
+            assert not leaf.sharding.is_fully_replicated, path
+            n_checked += 1
+    assert n_checked >= 10
+
+
+def test_zero1_tp_rejects_compressed_wire(devices):
+    """The GSPMD path's scatter/gather are layout constraints, not
+    explicit collectives — the wire codecs cannot wrap them; a compressed
+    wire request there must fail loudly with the reason."""
+    mesh_tp = build_mesh(MeshSpec(data=4, model=2), devices=devices)
+    with pytest.raises(ValueError, match="GSPMD"):
+        Trainer(LanguageModelingTask(), mesh_tp,
+                TrainConfig(zero1=True, wire_dtype="int8"),
+                rules=GPT2LMHead.partition_rules())
 
 
 def test_zero1_rejects_fsdp_rule_conflict(devices):

@@ -397,13 +397,17 @@ def _run(args, guard):
                              warmup_steps=args.warmup_steps)
     from distributed_pytorch_training_tpu.parallel.mesh import BATCH_AXES
 
-    # zero1 on a single batch shard runs the replicated (non-shard_map)
+    # zero1/fsdp on a single batch shard run the replicated (non-shard_map)
     # update, where a shard-axes psum would hit unbound axis names — the
     # clip's shard awareness must follow the same passthrough condition.
-    zero1_sharded = args.zero1 and n_batch_shards > 1
+    # The zero1 x model-axis composition runs the GSPMD update on GLOBAL
+    # flat arrays (training/loop.py), so its clip stays stock too.
+    model_axis = mesh.shape.get("model", 1) > 1
+    sharded_update = ((args.zero1 and not model_axis) or args.fsdp_explicit) \
+        and n_batch_shards > 1
     tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
                         weight_decay=args.weight_decay,
-                        shard_axes=BATCH_AXES if zero1_sharded else None)
+                        shard_axes=BATCH_AXES if sharded_update else None)
 
     rules = (type(model).partition_rules()
              if hasattr(type(model), "partition_rules") else None)
@@ -418,6 +422,7 @@ def _run(args, guard):
                                   print_freq=args.print_freq, seed=args.seed,
                                   bf16=args.amp, grad_accum=args.grad_accum,
                                   zero1=args.zero1,
+                                  fsdp_explicit=args.fsdp_explicit,
                                   bucket_cap_mb=args.bucket_cap_mb,
                                   wire_dtype=args.wire_dtype,
                                   overlap_grad_sync=not
@@ -426,10 +431,20 @@ def _run(args, guard):
                                                   "off": False}[
                                                       args.fused_quantize]),
                       rules=rules)
-    if args.zero1 and n_batch_shards > 1:
+    if args.fsdp_explicit and n_batch_shards > 1:
+        log_main(f"FSDP (explicit): params + moments flat-sharded "
+                 f"{n_batch_shards}-way at rest; per-layer just-in-time "
+                 "param gathers, gradients reduce-scattered into the shard "
+                 "layout"
+                 + (f"; {args.wire_dtype} wire" if args.wire_dtype != "fp32"
+                    else ""))
+    elif args.zero1 and n_batch_shards > 1:
         log_main(f"ZeRO-1: weight update sharded {n_batch_shards}-way over "
-                 "the batch axes (reduce-scatter grads -> 1/N optimizer "
-                 "update -> all-gather params"
+                 "the batch axes ("
+                 + ("per-leaf GSPMD update — model-axis mesh"
+                    if trainer._zero1_gspmd else
+                    "reduce-scatter grads -> 1/N optimizer update -> "
+                    "all-gather params")
                  + (f"; {args.wire_dtype} gradient wire"
                     if args.wire_dtype != "fp32" else "") + ")")
     elif trainer._grad_sync:
@@ -442,6 +457,11 @@ def _run(args, guard):
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
     n_params = state.param_count()
+    if trainer._fsdp and trainer._fsdp_template is not None:
+        # report the model-shaped count, not the flat-padded at-rest sizes
+        n_params = sum(
+            int(np.prod(t.shape) or 1) for t in
+            jax.tree_util.tree_leaves(trainer._fsdp_template))
     pad_extra = getattr(model, "vocab_pad_params", 0)
     if pad_extra:
         # Report the HF-exact count; padding rows are a TP layout artifact.
@@ -456,6 +476,12 @@ def _run(args, guard):
         plan = build_bucket_plan(state.params, args.bucket_cap_mb)
         log_main(f"Gradient sync: {plan.n_buckets} bucket(s) over "
                  f"{plan.total_bytes / 2 ** 20:.1f} MB of fp32 gradient")
+    if trainer._fsdp and trainer._fsdp_plan is not None:
+        lp = trainer._fsdp_plan
+        mb = lp.total_padded * 4 / 2 ** 20
+        log_main(f"FSDP plan: {len(lp.groups)} layer gather group(s), "
+                 f"{mb:.1f} MB padded fp32 params "
+                 f"({mb / n_batch_shards:.1f} MB/replica at rest)")
 
     # MFU in the step log (TPU only — needs a known chip peak): analytic
     # matmul/conv FLOPs of one train step, traced once on a peeked batch.
@@ -467,7 +493,8 @@ def _run(args, guard):
             peek = next(iter(train_loader.epoch(0)))
             fwd = flops_mod.jaxpr_matmul_flops(
                 lambda s, b: task.loss_and_metrics(
-                    s, s.params, b, jax.random.PRNGKey(0), train=True)[0],
+                    s, trainer._fsdp_unflatten(s.params) if trainer._fsdp
+                    else s.params, b, jax.random.PRNGKey(0), train=True)[0],
                 state, peek)
             trainer.set_mfu_reference(3.0 * fwd / global_batch,
                                       peak * 1e12 * mesh.size)
@@ -496,10 +523,12 @@ def _run(args, guard):
                 # lcm(128, model-axis)): resuming under a different --mesh
                 # builds a mismatched template and orbax fails opaquely.
                 # Diagnose precisely from the saved shape metadata.
-                hint = ("resume with the SAME --mesh and --zero1 setting "
-                        "(vocab padding for TP follows the model axis; "
-                        "zero1 stores optimizer state flat-sharded, the "
-                        "replicated path stores it param-shaped)")
+                hint = ("resume with the SAME --mesh, --zero1 and "
+                        "--fsdp-explicit settings (vocab padding for TP "
+                        "follows the model axis; zero1 stores optimizer "
+                        "state flat-sharded, fsdp-explicit stores params "
+                        "flat-sharded too, the replicated path stores "
+                        "both param-shaped)")
                 try:
                     meta = ckpt.latest_metadata()
                     saved_params = meta["params"] if meta else {}
