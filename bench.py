@@ -61,6 +61,11 @@ from distributed_pytorch_training_tpu.resilience.heartbeat import (  # noqa: E40
     Deathwatch, LivenessPolicy, port_listening as _port_listening,
     relay_ports as _relay_ports,
 )
+# Structured run telemetry (telemetry/, jax-free): the chip-probe failure
+# diagnostics are recorded as typed events (not just stderr prints that
+# die with the terminal), the headline row carries the stream's path, and
+# a failed backend bring-up flushes a flight_<ts>.json postmortem.
+from distributed_pytorch_training_tpu import telemetry as _telemetry  # noqa: E402
 
 HISTORY_PATH = Path(__file__).resolve().parent / \
     "distributed_pytorch_training_tpu" / "experiments" / "results" / \
@@ -362,12 +367,21 @@ def init_backend_with_retry(init_budget_s: float = 300.0,
         if ok:
             _log(f"bench: backend probe {attempt} up in {took:.1f}s: "
                  f"{detail}")
+            _telemetry.emit("event", "chip_probe_ok", attempt=attempt,
+                            took_s=round(took, 1), detail=detail)
             break
         _log(f"bench: backend probe {attempt} failed ({took:.1f}s): {detail}")
+        # the recorded form of the diagnostic: a typed event in the
+        # telemetry stream (and the flight ring), so a failed bring-up is
+        # attributable after the fact instead of living only on stderr
+        _telemetry.emit("event", "chip_probe_failure", attempt=attempt,
+                        took_s=round(took, 1), detail=detail,
+                        orphaned=orphaned)
         if "hung" in detail or "UNAVAILABLE" in detail:
             tunnel = _tunnel_status()
             if tunnel:
                 _log(f"bench: note: {tunnel}")
+                _telemetry.emit("event", "tunnel_status", status=tunnel)
         if orphaned:
             # An un-reapable probe may still hold the chip claim; more
             # probes can only fail against it. Fail fast instead of
@@ -754,6 +768,18 @@ def _record_history(result: dict) -> None:
 
 def _bench(args):
     t_start = time.monotonic()
+    # Telemetry stream for this bench invocation (before the backend is
+    # touched, so the probe diagnostics land in it). Best-effort: a
+    # read-only results dir must not cost the measurement.
+    telemetry_path = None
+    try:
+        telemetry_path = str(HISTORY_PATH.parent / "telemetry_bench.jsonl")
+        _telemetry.configure(telemetry_path,
+                             meta={"entry": "bench.py",
+                                   "batch_size": args.batch_size})
+    except Exception as e:
+        telemetry_path = None
+        _log(f"bench: telemetry disabled ({e})")
     # Armed before anything can block on the tunnel (incl. the test hooks):
     # a dead relay turns every later RPC into an unbounded UNAVAILABLE
     # retry loop, so the watch must outlive every phase of the run.
@@ -810,6 +836,10 @@ def _bench(args):
             init_budget_s=init_budget,
             probe_timeout_s=min(args.probe_timeout, init_budget))
     except Exception as e:
+        # the bring-up failure's postmortem artifact: the probe-event ring
+        # + cause, next to the history file (rc!=0 leaves a flight)
+        _telemetry.flush_flight(cause=f"backend init failed: {e}",
+                                detail="bench.py chip probe budget", rc=1)
         print(json.dumps({
             "metric": "resnet18_cifar10_train_throughput_bf16"
                       f"_b{args.batch_size}",
@@ -912,6 +942,10 @@ def _bench(args):
             "configs": [c for c in [headline, fp32] + extras if c],
             "configs_skipped": skipped,
             "bench_seconds": round(time.monotonic() - t_start, 1),
+            # where this invocation's typed event stream (probe events,
+            # save_blocked spans, wire counters) landed — `telemetry
+            # summary <path>` reads it (ISSUE 8)
+            "telemetry_path": telemetry_path,
         }
 
     # Headline: ResNet-18/CIFAR-10 (the reference's workload) in bf16 FIRST —

@@ -465,6 +465,120 @@ def check_pallas_call_in_ops(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule 7: no telemetry emission inside traced bodies
+# ---------------------------------------------------------------------------
+
+# The telemetry package's module name (any import path component match:
+# absolute `distributed_pytorch_training_tpu.telemetry`, relative
+# `..telemetry`, `from .. import telemetry`).
+_TELEMETRY_MODULE = "telemetry"
+
+
+def _telemetry_bindings(ctx: FileContext
+                        ) -> Tuple[Set[str], Set[str], Set[str]]:
+    """(module aliases, member names, dotted prefixes) this file bound to
+    the telemetry package. Walked here directly (not via ctx.members)
+    because the repo imports telemetry RELATIVELY (``from .. import
+    telemetry``), which the shared alias maps skip by design.
+
+    An UNALIASED ``import pkg.telemetry`` binds only the ROOT name
+    ``pkg`` — flagging every call rooted at ``pkg`` would false-positive
+    on ``pkg.parallel.psum(...)``, so that form is tracked as the full
+    dotted prefix (``pkg.telemetry``) and matched against the call's raw
+    attribute chain instead."""
+    mods: Set[str] = set()
+    members: Set[str] = set()
+    dotted: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                if _TELEMETRY_MODULE not in parts:
+                    continue
+                if a.asname:
+                    mods.add(a.asname)
+                elif len(parts) == 1:
+                    mods.add(a.name)  # `import telemetry` itself
+                else:
+                    dotted.add(a.name)
+        elif isinstance(node, ast.ImportFrom):
+            mod_parts = (node.module or "").split(".")
+            if _TELEMETRY_MODULE in mod_parts:
+                # from ..telemetry import span / from ..telemetry.recorder
+                # import Recorder — every bound name is a telemetry member
+                for a in node.names:
+                    members.add(a.asname or a.name)
+            else:
+                # from .. import telemetry [as tel]
+                for a in node.names:
+                    if a.name == _TELEMETRY_MODULE:
+                        mods.add(a.asname or a.name)
+    return mods, members, dotted
+
+
+def _raw_dotted(node: ast.AST) -> Optional[str]:
+    """The literal dotted text of a Name/Attribute chain (no alias
+    expansion), or None for non-trivial roots (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+@rule("telemetry-emit-outside-traced", "ast",
+      "telemetry Recorder calls are forbidden inside jit/shard_map-traced "
+      "bodies",
+      "a telemetry emit inside a traced body would execute ONCE at trace "
+      "time (recording a single bogus event, never one per step) and — "
+      "worse — any attempt to make it per-step would need a host callback "
+      "or sync inside the compiled step, exactly the stall class the "
+      "no-host-sync-in-step rule exists to kill. Instrumentation is "
+      "host-side by contract: spans wrap the dispatched step, they never "
+      "live inside it (PARITY.md pins telemetry-on/off HLO identity).")
+def check_telemetry_in_traced(ctx: FileContext) -> List[Finding]:
+    mods, members, dotted = _telemetry_bindings(ctx)
+    if not mods and not members and not dotted:
+        return []
+    name = "telemetry-emit-outside-traced"
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for fndef in _traced_defs(ctx):
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            func = node.func
+            # telemetry.span(...) / tel.recorder.emit(...): any attribute
+            # chain rooted at a telemetry module alias
+            head = func
+            while isinstance(head, ast.Attribute):
+                head = head.value
+            hit = (isinstance(head, ast.Name) and head.id in mods
+                   and isinstance(func, ast.Attribute))
+            # span(...) imported from the telemetry package directly
+            hit = hit or (isinstance(func, ast.Name) and func.id in members)
+            # pkg.telemetry.emit(...) under an unaliased dotted import:
+            # matched against the dotted prefix, so pkg.parallel.psum(...)
+            # rooted at the same package name never false-positives
+            if not hit and dotted:
+                raw = _raw_dotted(func)
+                hit = bool(raw) and any(raw.startswith(d + ".")
+                                        for d in dotted)
+            if hit:
+                out.append(Finding(
+                    name,
+                    f"telemetry call inside traced function "
+                    f"`{fndef.name}` — emission is host-side only "
+                    "(executes once at trace time here; wrap the "
+                    "dispatched step instead)", ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 

@@ -26,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-from .. import native
+from .. import native, telemetry
 from ..parallel.mesh import batch_shard_count
 from ..parallel.sharding import shard_batch
 from .datasets import ArrayDataset
@@ -155,6 +155,10 @@ class ShardedLoader:
         t.start()
         try:
             while True:
+                # prefetch health: depth 0 at consume time means the
+                # producer is behind (the loader-stall signature the
+                # anomaly watchdog sees as a data_wait spike)
+                telemetry.gauge("loader_queue_depth", q.qsize())
                 item = q.get()
                 if item is sentinel:
                     if err:

@@ -461,7 +461,7 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                 s, trainer._fsdp_unflatten(s.params) if trainer._fsdp
                 else s.params, b, key, train=True)[0], state, batch)
 
-        from ..parallel.grad_sync import wire_bytes_for_config
+        from ..parallel.grad_sync import emit_wire_accounting
         from ..parallel.mesh import batch_shard_count
         from .trace_analysis import grad_sync_census
 
@@ -471,25 +471,25 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                                     zero1=zero1, grad_sync=grad_sync)
         # per-replica wire accounting of the configured sync mode (the
         # gather-int8 break-even and the multihop flat ~2 B/element as
-        # recorded bench numbers). The helper's conventions are the
-        # bucketed/replicated reducer's; zero1's split wire (compressed
-        # scatter + exact param gather) is out of its scope — omitted.
+        # recorded bench numbers). One call computes the row values AND
+        # emits the telemetry counters (emit_wire_accounting is THE
+        # emission site — the stream and the bench row read the same
+        # numbers by construction; no-op stream-side when no recorder is
+        # configured). The helper's conventions are the bucketed/
+        # replicated reducer's; zero1's split wire (compressed scatter +
+        # exact param gather) is out of its scope — omitted. The gather
+        # split (ISSUE 7) is recorded for real fsdp trainers only:
+        # state.params' flat leaves carry the same padded totals as the
+        # model shapes.
         wire_bytes = None
         gather_bytes = None
         if not zero1:
-            wire_bytes = wire_bytes_for_config(
-                state.params, grad_sync, batch_shard_count(trainer.mesh))
+            acct = emit_wire_accounting(
+                state.params, grad_sync, batch_shard_count(trainer.mesh),
+                model=model_name)
+            wire_bytes = acct["wire_bytes_per_replica"]
             if trainer._fsdp:
-                # the per-layer param-gather traffic term alone (ISSUE 7):
-                # wire_bytes above is scatter + gather; recording the
-                # gather split lets bench history see which direction a
-                # wire-mode change moved. state.params' flat leaves carry
-                # the same padded totals as the model shapes.
-                from ..parallel.grad_sync import fsdp_gather_bytes
-                gather_bytes = fsdp_gather_bytes(
-                    state.params,
-                    (grad_sync or {}).get("wire_dtype", "fp32"),
-                    batch_shard_count(trainer.mesh))
+                gather_bytes = acct.get("fsdp_gather_bytes")
 
         exposed_comm_pct = None
         if comm_trace and len(devices) > 1:
@@ -508,6 +508,13 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # donates the state buffers, so after timed_steps this state is
         # consumed — and the saves must not sit inside a timing window.
         save_blocked = checkpoint_save_ab(state) if ckpt_ab else None
+
+        # the exposed-comm split rides the stream too (wire-byte counters
+        # were already emitted by emit_wire_accounting above)
+        if exposed_comm_pct is not None:
+            from .. import telemetry
+            telemetry.counter("exposed_comm_pct", exposed_comm_pct,
+                              model=model_name)
 
         sps, samples_per_s = timed_steps(compiled, state, batch, global_batch,
                                          steps, repeats,

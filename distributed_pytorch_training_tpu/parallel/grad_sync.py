@@ -252,6 +252,38 @@ def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
     return wire_bytes_per_replica(plan, wire, n_shards)
 
 
+def emit_wire_accounting(params: Any, grad_sync_cfg: Optional[dict],
+                         n_shards: int, tier: str = "ici",
+                         **attrs: Any) -> dict:
+    """Record the configured sync mode's per-replica wire accounting as
+    telemetry counters (host-side, setup-time — called once by train.py /
+    the bench harness, NEVER from traced code) and return the numbers —
+    THE one emission site, so the stream and the bench rows cannot drift.
+
+    ``tier`` names the interconnect the bytes ride — "ici" is the only
+    tier today; the ROADMAP's two-tier (ICI + DCN) hierarchical sync will
+    emit one counter set per tier through this same call, which is why
+    the attribute exists now (per-tier byte/time telemetry is the
+    substrate that item presumes). Extra ``attrs`` (e.g. the bench's
+    ``model=...``) ride every emitted counter."""
+    from .. import telemetry
+
+    cfg = dict(grad_sync_cfg or {})
+    wire = cfg.get("wire_dtype", "fp32")
+    out = {"tier": tier, "wire_dtype": wire, "n_shards": n_shards,
+           "wire_bytes_per_replica": wire_bytes_for_config(
+               params, cfg, n_shards)}
+    telemetry.counter("wire_bytes_per_replica",
+                      out["wire_bytes_per_replica"], tier=tier,
+                      wire_dtype=wire, n_shards=n_shards, **attrs)
+    if cfg.get("fsdp_explicit"):
+        out["fsdp_gather_bytes"] = fsdp_gather_bytes(params, wire, n_shards)
+        telemetry.counter("fsdp_gather_bytes", out["fsdp_gather_bytes"],
+                          tier=tier, wire_dtype=wire, n_shards=n_shards,
+                          **attrs)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Layer plan (explicit FSDP): the per-layer cut of the parameter tree
 # ---------------------------------------------------------------------------

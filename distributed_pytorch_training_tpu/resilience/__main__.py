@@ -23,7 +23,51 @@ import argparse
 import json
 import sys
 import tempfile
+from pathlib import Path
 from typing import List, Optional
+
+# What an injected fault's flight artifact must say: fault KIND -> the
+# substring its flight cause carries. The injected-crash causes quote the
+# fault label verbatim ("FaultError: injected crash@step=3"); sigterm
+# surfaces as the preemption drain; torn checkpoints as the integrity
+# skip. loader_stall is absent by design: a stall is not an exit (the
+# anomaly watchdog covers it as an `anomaly` event / optional abort).
+FLIGHT_SIGNATURES = {
+    "crash": "crash@step",
+    "crash_during_save": "crash_during_save",
+    "sigterm": "sigterm",
+    "torn_ckpt": "torn_checkpoint",
+}
+
+
+def check_flights(flight_dir, fired: List[str],
+                  ignore: Optional[set] = None) -> dict:
+    """Verify every fired fault with a flight signature left a parseable
+    ``flight_*.json`` whose cause matches — the chaos acceptance bar for
+    the flight recorder (ISSUE 8). ``ignore`` holds flight paths that
+    existed BEFORE the run: a reused ``--ckpt-dir`` must not let a
+    previous run's postmortems satisfy (or a stale unparseable one fail)
+    THIS run's verification."""
+    flights = []
+    for p in sorted(Path(flight_dir).glob("flight_*.json")):
+        if ignore and p in ignore:
+            continue
+        try:
+            body = json.loads(p.read_text())
+            flights.append({"path": str(p), "cause": body.get("cause", ""),
+                            "n_events": body.get("n_events")})
+        except ValueError:
+            flights.append({"path": str(p), "cause": None,
+                            "error": "unparseable"})
+    causes = [f["cause"] or "" for f in flights]
+    missing = []
+    for label in fired:
+        sig = FLIGHT_SIGNATURES.get(label.split("@")[0])
+        if sig is not None and not any(sig in c for c in causes):
+            missing.append(label)
+    ok = not missing and all(f["cause"] is not None for f in flights)
+    return {"flights": flights, "flights_missing": missing,
+            "flights_ok": ok}
 
 
 def _build_rig(mesh, seed: int, dataset_size: int, per_device_batch: int,
@@ -109,12 +153,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh, args.seed, args.dataset_size, args.per_device_batch,
         fault_hook=injector.on_loader_batch)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="dpt-chaos-")
+    # Telemetry + flight recorder (telemetry/): the supervisor flushes a
+    # flight_<ts>.json per failure/drain into this stream's directory —
+    # the chaos run then VERIFIES every injected fault left its
+    # postmortem (check_flights), not just that training recovered.
+    from .. import telemetry
+    telemetry.configure(str(Path(ckpt_dir) / "telemetry_rank0.jsonl"),
+                        meta={"entry": "resilience chaos",
+                              "chaos": args.chaos})
     # async saves ON (the production default): the schedule's
     # crash_during_save fault dies on the background writer and must
     # surface at the next save/wait barrier inside the recovery scope
     ckpt = CheckpointManager(ckpt_dir, post_save_hook=injector.on_save,
                              pre_finalize_hook=injector.on_save_finalize)
     guard = PreemptionGuard.install()
+    # flights already in the dir belong to a PREVIOUS run (user-supplied
+    # --ckpt-dir reuse) — excluded from this run's verification
+    pre_existing_flights = set(Path(ckpt_dir).glob("flight_*.json"))
     # fast, deterministic backoff: chaos is a harness, not a prod outage
     retry = RetryPolicy(max_restarts=args.max_restarts, backoff_base_s=0.01,
                         backoff_max_s=0.05, seed=args.seed)
@@ -131,6 +186,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         guard.reset()
         ckpt.close()
+        telemetry.reset()  # close the JSONL; flights are already on disk
+    flight_stats = check_flights(ckpt_dir, report.faults_fired,
+                                 ignore=pre_existing_flights)
 
     parity = None
     if state is not None and not args.no_verify_parity:
@@ -155,9 +213,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              # the async-save instrument: loop-blocked ms vs snapshot ms
              "save_blocked_ms": round(ckpt.save_blocked_ms, 1),
              "snapshot_ms": round(ckpt.snapshot_ms, 1),
+             **flight_stats,
              **report.as_dict()}
+    # flights_ok is part of RECOVERED: a fault that left no postmortem
+    # artifact would make the next real incident undiagnosable
     ok = (report.completed and report.fence_violations == 0
-          and parity is not False and error is None)
+          and parity is not False and error is None
+          and flight_stats["flights_ok"])
     if args.as_json:
         print(json.dumps(stats, sort_keys=True))
     else:
@@ -166,6 +228,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "fence_violations", "final_step", "parity_bitwise"):
             print(f"{k}: {stats[k]}")
         print(f"faults fired: {stats['faults_fired']}")
+        print(f"flight artifacts: {len(stats['flights'])} "
+              f"(ok={stats['flights_ok']}"
+              + (f", missing={stats['flights_missing']}"
+                 if stats["flights_missing"] else "") + ")")
         if stats["faults_unfired"]:
             print(f"faults NEVER fired (schedule past the run?): "
                   f"{stats['faults_unfired']}")

@@ -286,4 +286,15 @@ class Deathwatch:
             # client close, bounded — the dead port can hang any teardown
             # RPC, so the attempt is abandoned at its deadline (r5 #3).
             try_clean_pjrt_close(timeout_s=5.0, log=self.log)
+        # Flight recorder: the lethal abort is exactly the exit that loses
+        # the JSONL tail — flush the ring + cause first (telemetry is
+        # jax-free and flush_flight never raises/blocks unboundedly, so
+        # this cannot re-create the hang being escaped).
+        try:
+            from ..telemetry import flush_flight
+            flush_flight(cause=f"deathwatch: relay ports {dead} dead",
+                         detail="lethal relay deathwatch abort",
+                         rc=pol.exit_code)
+        except Exception:  # a broken flight must never block the abort
+            pass
         hard_exit(pol.exit_code)

@@ -35,6 +35,8 @@ import random
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
+from ..telemetry import flush_flight
+from ..telemetry import recorder as _telemetry
 from ..utils.logging import log_main
 
 
@@ -220,8 +222,17 @@ class Supervisor:
         if self.ckpt is not None:
             # a torn checkpoint is skipped by EVERY later restore; count
             # distinct labels, not skip events
+            fresh_skips = sorted(set(self.ckpt.last_skipped)
+                                 - self._skipped_labels)
             self._skipped_labels.update(self.ckpt.last_skipped)
             report.checkpoints_skipped = len(self._skipped_labels)
+            if fresh_skips:
+                # each NEWLY-discovered torn checkpoint leaves its own
+                # postmortem (the torn_ckpt chaos fault's flight artifact)
+                flush_flight(
+                    cause=f"torn_checkpoint: labels {fresh_skips} failed "
+                          "integrity verification",
+                    detail="supervisor restore skipped torn checkpoint(s)")
         if restored is None:
             if self.ckpt is not None:
                 log_main("supervisor: no valid checkpoint — "
@@ -299,17 +310,34 @@ class Supervisor:
                     report.failures.append(
                         f"{type(e).__name__}: {e} (during preemption drain"
                         " — not restarted)")
+                    flush_flight(
+                        cause=f"{type(e).__name__}: {e}",
+                        detail="failure during preemption (sigterm) drain "
+                               "— not restarted", rc=1)
                     log_main("supervisor: failure during preemption drain; "
                              "stopping (relaunch resumes from the last "
                              "checkpoint)")
                     break
                 report.restarts += 1
                 report.failures.append(f"{type(e).__name__}: {e}")
+                # the per-failure postmortem: the injected chaos faults'
+                # flight artifacts carry the fault label verbatim in the
+                # cause (e.g. "FaultError: injected crash@step=3")
+                flush_flight(
+                    cause=f"{type(e).__name__}: {e}",
+                    detail=f"supervisor restart {report.restarts}/"
+                           f"{self.retry.max_restarts}")
+                _telemetry.counter("restarts", 1)
                 if report.restarts > self.retry.max_restarts:
                     report.final_step = -1
                     if self.injector is not None:
                         report.faults_fired = list(self.injector.fired)
                         report.faults_unfired = self.injector.unfired()
+                    flush_flight(
+                        cause=f"supervisor abort: retry budget "
+                              f"({self.retry.max_restarts}) exhausted; "
+                              f"last failure: {type(e).__name__}: {e}",
+                        detail="SupervisorError", rc=1)
                     err = SupervisorError(
                         f"giving up after {self.retry.max_restarts} "
                         f"restart(s); last failure: {e}")
@@ -367,6 +395,12 @@ class Supervisor:
                                 f"{type(e2).__name__}: {e2} (relay-death "
                                 "re-save ALSO failed; aborting on the "
                                 "last durable checkpoint)")
+                flush_flight(
+                    cause=f"relay_death: ports "
+                          f"{getattr(self.deathwatch, 'dead_ports', [])} "
+                          "dead (advisory deathwatch)",
+                    detail=f"checkpoint-then-abort at epoch {epoch} step "
+                           f"{step}/{spe}", rc=70)
                 log_main(f"supervisor: relay tunnel died (ports "
                          f"{getattr(self.deathwatch, 'dead_ports', [])}) — "
                          f"checkpointed epoch {epoch} step {step}/{spe}; "
@@ -378,6 +412,15 @@ class Supervisor:
                 # (a preemption landing after the LAST epoch finished has
                 # nothing left to drain — the run is simply complete)
                 report.preemptions_drained += 1
+                # sigterm's flight artifact (both branches: a drained stop
+                # AND the chaos harness's simulated relaunch record what
+                # was interrupted and where it resumes)
+                flush_flight(
+                    cause=f"preemption (sigterm) drained at epoch {epoch} "
+                          f"step {step}/{spe}",
+                    detail="supervisor drain"
+                           + ("" if not self.resume_preempted
+                              else " + simulated relaunch"), rc=0)
                 if not self.resume_preempted:
                     report.preempted = True
                     log_main(f"supervisor: preempted — checkpointed epoch "

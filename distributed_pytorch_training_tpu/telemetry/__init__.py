@@ -1,0 +1,57 @@
+"""telemetry/ — unified structured run telemetry with a crash-surviving
+flight recorder (ISSUE 8).
+
+The reference promises "gradient sync profiling and scaling experiments"
+(README.md:23,:35) but ships scattered one-off instruments; here every
+instrument feeds ONE stream:
+
+* :class:`~.recorder.Recorder` — process-local typed events (host-side
+  spans, counters, gauges, anomalies) appended to a schema-versioned JSONL
+  (``telemetry_rank0.jsonl``, fsync'd on a cadence) AND kept in a bounded
+  in-memory ring buffer;
+* the **flight recorder** (:mod:`.flight`) — on any abnormal exit
+  (Deathwatch lethal probe, Supervisor retry/abort, chaos crash/sigterm,
+  unhandled exception) the ring's last N events + the exit cause are
+  flushed to ``flight_<ts>.json``, so every rc=70 / rc!=0 leaves a
+  postmortem artifact even when the JSONL's tail was lost;
+* the **anomaly watchdog** (:mod:`.watchdog`) — non-finite loss,
+  step-time spikes vs a rolling median, loader-stall detection, each an
+  ``anomaly`` event with an optional abort hook (off by default);
+* the ``telemetry`` CLI (:mod:`.__main__`) — ``summary`` (per-phase time
+  split + throughput + wire-byte totals), ``tail``, and
+  ``export --perfetto`` (host spans as Chrome trace-event JSON that loads
+  alongside an XLA trace in Perfetto).
+
+Design constraints (enforced, not aspirational):
+
+* **Host-side only.** Instrumentation lives around dispatched steps, never
+  inside traced code — the ``telemetry-emit-outside-traced`` AST rule
+  (analysis/ast_rules.py) forbids Recorder calls in jit/shard_map bodies,
+  and a tier-1 test pins that the lowered HLO of a telemetry-on and
+  telemetry-off run is IDENTICAL (PARITY.md: telemetry adds surfaces,
+  never changes training numerics).
+* **Zero cost when unconfigured.** The module-level emit helpers check one
+  global and return; no file, no ring, no timestamps.
+* **No jax at module scope.** The flight recorder must be callable from
+  resilience/heartbeat.py (which refuses to initialize a backend) and
+  from the bench driver before any backend exists.
+"""
+
+from __future__ import annotations
+
+from .recorder import (  # noqa: F401
+    SCHEMA_VERSION,
+    NullSpan,
+    Recorder,
+    configure,
+    counter,
+    emit,
+    gauge,
+    get,
+    is_configured,
+    reset,
+    span,
+    span_event,
+)
+from .flight import flush_flight, install_excepthook  # noqa: F401
+from .watchdog import AnomalyAbort, AnomalyWatchdog  # noqa: F401

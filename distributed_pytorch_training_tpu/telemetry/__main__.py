@@ -1,0 +1,247 @@
+"""``python -m distributed_pytorch_training_tpu.telemetry`` — read one
+telemetry JSONL stream (``telemetry_rank0.jsonl``) and report.
+
+Also installed as the ``telemetry`` console script (pyproject.toml).
+
+Commands:
+  summary <stream.jsonl> [--json]
+      Per-phase step-time split (data_wait / step_dispatch / device_sync /
+      save_blocked / eval / restore), throughput, wire-byte totals, and
+      anomaly counts — the "gradient sync share of step" table the
+      reference promised, computed from the stream's OWN recorded totals
+      (the split is checked against the recorded epoch seconds; the
+      unaccounted remainder is printed, never hidden).
+  tail <stream.jsonl> [-n N]
+      Last N events, one per line.
+  export <stream.jsonl> --perfetto -o trace.json
+      Host spans as Chrome trace-event JSON (``ph:"X"`` complete events,
+      wall-clock microseconds) — loads in Perfetto/chrome://tracing
+      alongside the XLA trace captured by utils/profiling.StepProfiler.
+
+Exit codes: 0 ok, 1 unreadable/empty stream, 2 usage error.
+
+jax-free by design: postmortems are read on machines with no accelerator
+stack (the same constraint as the recorder's).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from .recorder import SPAN_NAMES
+
+
+def read_stream(path: str) -> Tuple[List[dict], int]:
+    """(events, n_malformed). Malformed lines are counted, not fatal — a
+    stream torn mid-line by a crash must still summarize."""
+    events: List[dict] = []
+    bad = 0
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+                if not isinstance(ev, dict):
+                    raise ValueError("not an object")
+                events.append(ev)
+            except ValueError:
+                bad += 1
+    return events, bad
+
+
+def summarize(events: List[dict]) -> dict:
+    """The summary body: span totals, counter sums, gauge last-values,
+    the step-time split, and the self-consistency line."""
+    spans: dict = defaultdict(lambda: {"total_ms": 0.0, "count": 0,
+                                       "max_ms": 0.0})
+    counters: dict = defaultdict(float)
+    gauges: dict = {}
+    anomalies: List[dict] = []
+    meta: Optional[dict] = None
+    for ev in events:
+        kind = ev.get("kind")
+        name = ev.get("name", "?")
+        if kind == "span":
+            dur = float(ev.get("dur_ms", 0.0))
+            s = spans[name]
+            s["total_ms"] += dur
+            s["count"] += 1
+            s["max_ms"] = max(s["max_ms"], dur)
+        elif kind == "counter":
+            counters[name] += float(ev.get("value", 0.0))
+        elif kind == "gauge":
+            gauges[name] = ev.get("value")
+        elif kind == "anomaly":
+            anomalies.append(ev)
+        elif kind == "meta" and meta is None:
+            meta = ev
+
+    # the step-time split over the canonical phases, against the stream's
+    # own recorded wall total (the `epoch_time_s` counter the train loop
+    # emits per epoch) — phases are measured independently of the total,
+    # so the unaccounted remainder is an honesty check, not filler. Some
+    # phases legitimately sit OUTSIDE the epoch wall (eval, epoch-boundary
+    # save stalls), so when accounted spans exceed it the denominator is
+    # the accounted total instead — percentages always close to 100.
+    wall_ms = counters.get("epoch_time_s", 0.0) * 1e3
+    accounted = {n: spans[n]["total_ms"] for n in SPAN_NAMES if n in spans}
+    accounted_ms = sum(accounted.values())
+    split = {}
+    base = max(wall_ms, accounted_ms)
+    if base > 0:
+        split = {n: round(100.0 * v / base, 2)
+                 for n, v in accounted.items()}
+        if wall_ms > accounted_ms:
+            split["unaccounted"] = round(
+                100.0 * (wall_ms - accounted_ms) / base, 2)
+
+    out = {
+        "schema": (meta or {}).get("schema"),
+        "run_id": (meta or {}).get("run_id"),
+        "n_events": len(events),
+        "spans": {n: {"total_ms": round(v["total_ms"], 3),
+                      "count": v["count"],
+                      "mean_ms": round(v["total_ms"] / v["count"], 4)
+                      if v["count"] else 0.0,
+                      "max_ms": round(v["max_ms"], 3)}
+                  for n, v in sorted(spans.items())},
+        "counters": {n: round(v, 4) for n, v in sorted(counters.items())},
+        "gauges": dict(sorted(gauges.items())),
+        "anomalies": [{"name": a.get("name"),
+                       **{k: v for k, v in a.items()
+                          if k not in ("v", "ts", "kind", "name")}}
+                      for a in anomalies],
+        "step_split_pct": split,
+        "totals": {
+            "recorded_wall_ms": round(wall_ms, 3),
+            "accounted_span_ms": round(accounted_ms, 3),
+            "unaccounted_ms": round(max(0.0, wall_ms - accounted_ms), 3)
+            if wall_ms > 0 else None,
+        },
+    }
+    if counters.get("epoch_time_s", 0.0) > 0 and "samples" in counters:
+        out["throughput"] = {
+            "samples": counters["samples"],
+            "samples_per_sec": round(
+                counters["samples"] / counters["epoch_time_s"], 2),
+        }
+    for key in ("wire_bytes_per_replica", "fsdp_gather_bytes",
+                "exposed_comm_pct"):
+        if key in counters:
+            out.setdefault("wire", {})[key] = counters[key]
+        elif key in gauges:
+            out.setdefault("wire", {})[key] = gauges[key]
+    return out
+
+
+def to_perfetto(events: List[dict]) -> dict:
+    """Chrome trace-event JSON: spans as complete ("X") events on one
+    host-telemetry track, anomalies/events as instants — timestamps are
+    wall-clock microseconds so the spans align with an XLA trace captured
+    in the same run."""
+    trace: List[dict] = []
+    pid = None
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "meta":
+            pid = ev.get("pid", pid)
+            continue
+        args = {k: v for k, v in ev.items()
+                if k not in ("v", "ts", "kind", "name", "t0", "dur_ms")}
+        common = {"pid": ev.get("pid", pid) or 0, "tid": 1,
+                  "cat": f"telemetry/{kind}", "name": ev.get("name", "?"),
+                  "args": args}
+        if kind == "span":
+            t0 = float(ev.get("t0", ev.get("ts", 0.0)))
+            trace.append({**common, "ph": "X", "ts": t0 * 1e6,
+                          "dur": float(ev.get("dur_ms", 0.0)) * 1e3})
+        else:
+            trace.append({**common, "ph": "i", "s": "p",
+                          "ts": float(ev.get("ts", 0.0)) * 1e6})
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def _print_summary(s: dict) -> None:
+    print(f"run {s.get('run_id')} — {s['n_events']} events")
+    if s["step_split_pct"]:
+        print("step-time split (% of recorded wall):")
+        for n, pct in sorted(s["step_split_pct"].items(),
+                             key=lambda kv: -kv[1]):
+            tot = s["spans"].get(n, {}).get("total_ms")
+            extra = f"  ({tot:.1f} ms)" if tot is not None else ""
+            print(f"  {n:16s} {pct:6.2f}%{extra}")
+    t = s["totals"]
+    if t["recorded_wall_ms"]:
+        print(f"recorded wall: {t['recorded_wall_ms']:.1f} ms, spans "
+              f"account for {t['accounted_span_ms']:.1f} ms")
+    if "throughput" in s:
+        print(f"throughput: {s['throughput']['samples_per_sec']:.2f} "
+              f"samples/s over {s['throughput']['samples']:.0f} samples")
+    if "wire" in s:
+        for k, v in s["wire"].items():
+            print(f"wire: {k} = {v}")
+    if s["anomalies"]:
+        print(f"ANOMALIES ({len(s['anomalies'])}):")
+        for a in s["anomalies"]:
+            print(f"  {a}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="telemetry", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("command", choices=["summary", "tail", "export"])
+    p.add_argument("stream", help="path to a telemetry JSONL stream")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("-n", type=int, default=20, help="tail: last N events")
+    p.add_argument("--perfetto", action="store_true",
+                   help="export: Chrome trace-event JSON")
+    p.add_argument("-o", "--output", default=None,
+                   help="export: output path (default: stdout)")
+    args = p.parse_args(argv)
+
+    if not Path(args.stream).is_file():
+        print(f"telemetry: no such stream: {args.stream}", file=sys.stderr)
+        return 1
+    events, bad = read_stream(args.stream)
+    if bad:
+        print(f"telemetry: note: {bad} malformed line(s) skipped",
+              file=sys.stderr)
+    if not events:
+        print("telemetry: stream holds no events", file=sys.stderr)
+        return 1
+
+    if args.command == "summary":
+        s = summarize(events)
+        if args.as_json:
+            print(json.dumps(s, sort_keys=True))
+        else:
+            _print_summary(s)
+        return 0
+    if args.command == "tail":
+        for ev in events[-args.n:]:
+            print(json.dumps(ev, sort_keys=True))
+        return 0
+    # export
+    if not args.perfetto:
+        print("telemetry: export needs --perfetto (the only format so far)",
+              file=sys.stderr)
+        return 2
+    body = json.dumps(to_perfetto(events))
+    if args.output:
+        Path(args.output).write_text(body)
+        print(f"telemetry: wrote {args.output}", file=sys.stderr)
+    else:
+        print(body)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

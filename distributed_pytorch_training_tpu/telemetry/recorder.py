@@ -1,0 +1,260 @@
+"""Recorder: the process-local typed event stream.
+
+Event model (``SCHEMA_VERSION`` stamps every line; the first line of every
+JSONL is a ``meta`` event carrying the run context):
+
+=========  ==============================================================
+kind       meaning / required fields
+=========  ==============================================================
+meta       stream header: schema, run_id, pid, argv hint
+span       one timed host-side region: ``name``, ``t0`` (wall seconds at
+           entry), ``dur_ms``. Canonical names: ``data_wait``,
+           ``step_dispatch``, ``device_sync``, ``eval``, ``save_blocked``,
+           ``restore`` — free-form names are legal, the canonical set is
+           what ``telemetry summary`` buckets into the step-time split.
+counter    monotonic count/total: ``name``, ``value`` (summed by summary)
+gauge      instantaneous level: ``name``, ``value`` (last-wins)
+anomaly    watchdog detection: ``name`` + detection detail
+event      anything else worth a timestamped line (probe failures,
+           restarts, preemptions)
+exit       the flight recorder's cause record (also the flight file body)
+=========  ==============================================================
+
+Durability: every emit appends one JSON line; the file handle is flushed
+per line and ``os.fsync``'d on a cadence (``fsync_every_s``) plus at
+``flush()``/``close()`` — a crash loses at most the last cadence window of
+OS-buffered lines, and the flight recorder's explicitly-fsync'd
+``flight_*.json`` carries the ring's tail regardless.
+
+This module imports neither jax nor anything from the package that does:
+arming telemetry must never initialize a backend (the heartbeat
+constraint), and the CLI must read streams on machines with no accelerator
+stack at all. Process-0 gating is therefore the CALLER's job (train.py
+configures the recorder only on process 0 — the file is named
+``telemetry_rank0.jsonl`` for exactly that reason).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Deque, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# Canonical span names `telemetry summary` buckets into the step-time
+# split. Free-form names are legal; these are the contract.
+SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync", "eval",
+              "save_blocked", "restore")
+
+
+class Recorder:
+    """Append-only JSONL + bounded ring buffer of typed events.
+
+    ``path=None`` keeps a ring-only recorder (tests; flight-only use).
+    All emit paths are thread-safe: the checkpoint writer thread, the
+    loader producer thread, and the deathwatch thread all emit into the
+    same stream as the main loop.
+    """
+
+    def __init__(self, path: Optional[str] = None, ring_size: int = 512,
+                 fsync_every_s: float = 2.0, run_id: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.path = Path(path) if path is not None else None
+        self.ring: Deque[dict] = collections.deque(maxlen=max(1, ring_size))
+        self.run_id = run_id or f"run-{os.getpid()}-{int(time.time())}"
+        self._fsync_every_s = fsync_every_s
+        self._last_fsync = time.monotonic()
+        self._lock = threading.Lock()
+        self._fh = None
+        self.n_events = 0
+        if self.path is not None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self.emit("meta", "stream", schema=SCHEMA_VERSION,
+                  run_id=self.run_id, pid=os.getpid(),
+                  **(meta or {}))
+
+    # -- core ------------------------------------------------------------
+
+    def emit(self, kind: str, name: str, **fields: Any) -> dict:
+        """Append one event to the ring (always) and the JSONL (if open)."""
+        ev = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind,
+              "name": name}
+        ev.update(fields)
+        with self._lock:
+            self.ring.append(ev)
+            self.n_events += 1
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(ev, sort_keys=True,
+                                              default=str) + "\n")
+                    self._fh.flush()
+                    now = time.monotonic()
+                    if now - self._last_fsync >= self._fsync_every_s:
+                        os.fsync(self._fh.fileno())
+                        self._last_fsync = now
+                except (OSError, ValueError):
+                    # a full/readonly disk (or a handle closed under us)
+                    # must never take the training run down with it
+                    pass
+        return ev
+
+    # -- typed helpers ----------------------------------------------------
+
+    def span_event(self, name: str, dur_s: float, **attrs: Any) -> dict:
+        """A span whose duration the CALLER measured (the hot-loop form:
+        one perf_counter pair at the call site, no context-manager
+        overhead). ``t0`` is reconstructed as now - dur."""
+        return self.emit("span", name, t0=time.time() - dur_s,
+                         dur_ms=round(dur_s * 1e3, 4), **attrs)
+
+    def span(self, name: str, **attrs: Any) -> "_Span":
+        return _Span(self, name, attrs)
+
+    def counter(self, name: str, value: float, **attrs: Any) -> dict:
+        return self.emit("counter", name, value=value, **attrs)
+
+    def gauge(self, name: str, value: float, **attrs: Any) -> dict:
+        return self.emit("gauge", name, value=value, **attrs)
+
+    def anomaly(self, name: str, **fields: Any) -> dict:
+        return self.emit("anomaly", name, **fields)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def tail(self, n: int = 50) -> List[dict]:
+        with self._lock:
+            return list(self.ring)[-n:]
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._last_fsync = time.monotonic()
+                except (OSError, ValueError):
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError):
+                    pass
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    @property
+    def directory(self) -> Optional[Path]:
+        """Where flight files land (the JSONL's directory), or None for a
+        ring-only recorder (flights then need an explicit directory)."""
+        return self.path.parent if self.path is not None else None
+
+
+class _Span:
+    """Context manager measuring one host-side region with perf_counter
+    (monotonic — an NTP step mid-span cannot corrupt the duration; the
+    event's wall ``t0`` is for cross-log alignment only)."""
+
+    def __init__(self, recorder: Recorder, name: str, attrs: Dict[str, Any]):
+        self._rec = recorder
+        self._name = name
+        self._attrs = attrs
+        self._t0_wall = 0.0
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0_wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        dur = time.perf_counter() - self._t0
+        self._rec.emit("span", self._name, t0=self._t0_wall,
+                       dur_ms=round(dur * 1e3, 4),
+                       **({"error": f"{exc_type.__name__}"}
+                          if exc_type is not None else {}),
+                       **self._attrs)
+
+
+class NullSpan:
+    """The unconfigured path's span: enters and exits for free."""
+
+    def __enter__(self) -> "NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+_NULL_SPAN = NullSpan()
+
+# ---------------------------------------------------------------------------
+# The process-global recorder: one stream per process, installed by the
+# entry point (train.py / bench.py / the chaos CLI), consumed by every
+# instrumented layer through the no-op-when-unconfigured helpers below.
+# ---------------------------------------------------------------------------
+
+_RECORDER: Optional[Recorder] = None
+
+
+def configure(path: Optional[str] = None, **kwargs: Any) -> Recorder:
+    """Install the process-global recorder (closing any previous one)."""
+    global _RECORDER
+    if _RECORDER is not None:
+        _RECORDER.close()
+    _RECORDER = Recorder(path, **kwargs)
+    return _RECORDER
+
+
+def reset() -> None:
+    """Drop the global recorder (tests; end-of-run cleanup)."""
+    global _RECORDER
+    if _RECORDER is not None:
+        _RECORDER.close()
+    _RECORDER = None
+
+
+def get() -> Optional[Recorder]:
+    return _RECORDER
+
+
+def is_configured() -> bool:
+    return _RECORDER is not None
+
+
+def emit(kind: str, name: str, **fields: Any) -> None:
+    if _RECORDER is not None:
+        _RECORDER.emit(kind, name, **fields)
+
+
+def span(name: str, **attrs: Any):
+    """Context-manager span on the global recorder; free when off."""
+    if _RECORDER is None:
+        return _NULL_SPAN
+    return _RECORDER.span(name, **attrs)
+
+
+def span_event(name: str, dur_s: float, **attrs: Any) -> None:
+    if _RECORDER is not None:
+        _RECORDER.span_event(name, dur_s, **attrs)
+
+
+def counter(name: str, value: float, **attrs: Any) -> None:
+    if _RECORDER is not None:
+        _RECORDER.counter(name, value, **attrs)
+
+
+def gauge(name: str, value: float, **attrs: Any) -> None:
+    if _RECORDER is not None:
+        _RECORDER.gauge(name, value, **attrs)

@@ -1,0 +1,113 @@
+"""Anomaly watchdog: detections fed off the same host-side stream.
+
+Three detectors, each emitting a structured ``anomaly`` event into the
+recorder (and, with ``abort=True``, raising :class:`AnomalyAbort` — which
+under the restart Supervisor is a restartable failure like any other, so
+"abort" means checkpoint-restore-replay, not data loss):
+
+* **non-finite loss** — fed at print boundaries (the loop's only host
+  fetch; the watchdog must not add device syncs);
+* **step-time spike** — host wall per step vs a rolling median. Honest
+  scope: with async dispatch the host observes device time only through
+  donation backpressure once the pipeline fills, so the detector warms up
+  (``min_samples``) before judging and compares against the rolling
+  median, not the mean (compile steps would poison a mean forever);
+* **loader stall** — data-wait exceeding both an absolute floor and a
+  multiple of its own rolling median (the chaos ``loader_stall`` fault's
+  signature).
+
+The watchdog holds no device state and is jax-free.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import statistics
+from typing import Deque, Optional
+
+from . import recorder as _recorder
+
+
+class AnomalyAbort(RuntimeError):
+    """Raised by an ``abort=True`` watchdog on detection — under the
+    Supervisor this is a restartable step failure (restore + replay)."""
+
+
+class AnomalyWatchdog:
+    """Rolling-median anomaly detection over per-step host timings.
+
+    ``spike_factor``: a step slower than factor x median (after
+    ``min_samples`` warm-up steps) is a ``step_time_spike``.
+    ``stall_factor`` / ``stall_min_s``: a data wait above BOTH
+    ``stall_min_s`` and factor x its median is a ``loader_stall``.
+    ``abort``: raise :class:`AnomalyAbort` on detection (default: observe
+    only). Detections are also counted on the instance for tests/reports.
+    """
+
+    def __init__(self, spike_factor: float = 5.0, min_samples: int = 20,
+                 stall_factor: float = 10.0, stall_min_s: float = 1.0,
+                 window: int = 128, abort: bool = False):
+        if spike_factor <= 1.0 or stall_factor <= 1.0:
+            raise ValueError("spike/stall factors must be > 1")
+        self.spike_factor = spike_factor
+        self.min_samples = max(2, min_samples)
+        self.stall_factor = stall_factor
+        self.stall_min_s = stall_min_s
+        self.abort = abort
+        self._step_s: Deque[float] = collections.deque(maxlen=window)
+        self._wait_s: Deque[float] = collections.deque(maxlen=window)
+        self.anomalies: list = []
+
+    # -- detections --------------------------------------------------------
+
+    def _fire(self, name: str, **fields) -> None:
+        self.anomalies.append((name, fields))
+        _recorder.emit("anomaly", name, **fields)
+        if self.abort:
+            raise AnomalyAbort(
+                f"anomaly watchdog: {name} "
+                + " ".join(f"{k}={v}" for k, v in fields.items()))
+
+    def observe_step(self, step: int, step_s: float,
+                     data_wait_s: Optional[float] = None) -> None:
+        """Feed one step's host wall time (+ its data wait). Samples are
+        recorded AFTER the check so a spike never judges itself normal.
+
+        Attribution: the stall detector runs FIRST and the spike detector
+        judges the BUSY time (step minus data wait) — a step made slow by
+        its loader is a loader_stall, never additionally a
+        step_time_spike (the stall's shadow would otherwise fire first
+        under abort=True and misname the cause)."""
+        busy_s = max(0.0, step_s - (data_wait_s or 0.0))
+        if data_wait_s is not None and len(self._wait_s) >= self.min_samples:
+            med_w = statistics.median(self._wait_s)
+            if data_wait_s > self.stall_min_s and \
+                    data_wait_s > self.stall_factor * max(med_w, 1e-9):
+                # record the samples before a potential abort-raise so a
+                # replayed step re-enters a warmed-up detector
+                self._step_s.append(busy_s)
+                self._wait_s.append(data_wait_s)
+                self._fire("loader_stall", step=step,
+                           data_wait_s=round(data_wait_s, 4),
+                           median_wait_s=round(med_w, 6))
+                return
+        if len(self._step_s) >= self.min_samples:
+            med = statistics.median(self._step_s)
+            if med > 0 and busy_s > self.spike_factor * med:
+                self._step_s.append(busy_s)
+                if data_wait_s is not None:
+                    self._wait_s.append(data_wait_s)
+                self._fire("step_time_spike", step=step,
+                           step_s=round(busy_s, 4),
+                           median_s=round(med, 4),
+                           factor=round(busy_s / med, 2))
+                return
+        self._step_s.append(busy_s)
+        if data_wait_s is not None:
+            self._wait_s.append(data_wait_s)
+
+    def observe_loss(self, step: int, loss: float) -> None:
+        """Feed a host-fetched loss (print boundaries — never a new sync)."""
+        if not math.isfinite(loss):
+            self._fire("non_finite_loss", step=step, loss=str(loss))

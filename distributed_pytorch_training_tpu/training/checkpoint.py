@@ -62,6 +62,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..utils.logging import log_main
+from .. import telemetry
 from .train_state import TrainState
 
 _MANIFEST_DIRNAME = ".manifests"
@@ -349,7 +350,13 @@ class CheckpointManager:
             t.start()
         else:
             self._write_job(label, snapshot, step_value)
-        self.save_blocked_ms += (time.perf_counter() - t0) * 1e3
+        blocked_s = time.perf_counter() - t0
+        self.save_blocked_ms += blocked_s * 1e3
+        # the save_blocked telemetry span: exactly the caller-thread stall
+        # this save cost the train loop (under async ≈ the snapshot copy)
+        telemetry.span_event("save_blocked", blocked_s, label=label,
+                             phase="save",
+                             async_save=bool(self._async and not wait))
 
     def restore_latest(
         self, template: TrainState, among=None,
@@ -377,6 +384,8 @@ class CheckpointManager:
                 log_main(f"CHECKPOINT INTEGRITY: checkpoint {label} is "
                          f"torn ({problem}) — skipping it and trying the "
                          "previous one")
+                telemetry.emit("event", "torn_checkpoint_skipped",
+                               label=label, problem=problem)
                 self.last_skipped.append(label)
                 continue
             return self._restore(label, template)
@@ -388,6 +397,11 @@ class CheckpointManager:
 
     def _restore(self, label: int,
                  template: TrainState) -> Tuple[TrainState, int, int]:
+        with telemetry.span("restore", label=label):
+            return self._restore_inner(label, template)
+
+    def _restore_inner(self, label: int,
+                       template: TrainState) -> Tuple[TrainState, int, int]:
         want = _arrays(template)
         if "grad_sync" in want:
             # An int8-wire template resuming a checkpoint written WITHOUT
@@ -439,7 +453,9 @@ class CheckpointManager:
             self._join_writer()
             self._mgr.wait_until_finished()
         finally:
-            self.save_blocked_ms += (time.perf_counter() - t0) * 1e3
+            blocked_s = time.perf_counter() - t0
+            self.save_blocked_ms += blocked_s * 1e3
+            telemetry.span_event("save_blocked", blocked_s, phase="wait")
 
     def close(self) -> None:
         self._join_writer(reraise=False)

@@ -53,6 +53,7 @@ from ..parallel.sharding import (
 )
 from ..utils.logging import log_main
 from ..utils.metrics import ThroughputMeter
+from .. import telemetry
 from .tasks import Task, add_metrics, summarize, zero_metrics
 from .train_state import TrainState
 
@@ -189,6 +190,10 @@ class Trainer:
         # throughput print lines also report model-FLOPs utilization
         self._flops_per_sample: Optional[float] = None
         self._peak_flops_total: Optional[float] = None
+        # optional telemetry.AnomalyWatchdog fed per-step host timings and
+        # print-boundary losses by train_epoch (train.py installs it; None
+        # everywhere else — the hot path pays two perf_counter reads)
+        self.watchdog = None
 
         if config.wire_dtype not in WIRE_DTYPES:
             raise ValueError(
@@ -1236,26 +1241,64 @@ class Trainer:
         BEFORE the step executes (so a raise there means the optimizer
         never applied the step — the restart supervisor's restore point)
         and is None on every un-supervised run (the hot path pays
-        nothing)."""
+        nothing).
+
+        Telemetry (host-side only — nothing here touches traced code, and
+        the ``telemetry-emit-outside-traced`` AST rule keeps it that way):
+        per-step ``data_wait`` (time blocked on the loader iterator) and
+        ``step_dispatch`` (time inside the jitted-call dispatch — with
+        donation backpressure this tracks device step time once the
+        pipeline fills) spans, a ``device_sync`` span around the epoch's
+        one block_until_ready, and epoch counters (``epoch_time_s``,
+        ``steps``, ``samples``) — the totals ``telemetry summary`` checks
+        its split against. ``self.watchdog`` (an AnomalyWatchdog) is fed
+        the same timings plus print-boundary losses; with its abort hook
+        on, a detection raises AnomalyAbort — under the Supervisor, a
+        restartable step failure like any other."""
         cfg = self.config
         epoch_key = jax.random.fold_in(jax.random.PRNGKey(cfg.seed), epoch)
 
         epoch_metrics = zero_metrics()
-        t_epoch = time.time()
+        # perf_counter, not time.time(): an NTP step mid-epoch would
+        # corrupt the CSV's epoch_time_seconds (the ThroughputMeter got
+        # the same fix)
+        t_epoch = time.perf_counter()
         meter = ThroughputMeter()
         steps_done = 0
+        epoch_samples = 0
+        watchdog = self.watchdog
 
-        for i, batch in enumerate(batches):
+        it = iter(batches)
+        i = 0
+        while True:
+            t_wait = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            data_wait_s = time.perf_counter() - t_wait
+            telemetry.span_event("data_wait", data_wait_s,
+                                 step=start_step + i)
             if fault_hook is not None:
                 fault_hook(i)
             if step_hook is not None:
                 step_hook(i)
+            t_disp = time.perf_counter()
             state, metrics = self._train_step(state, batch, epoch_key)
+            dispatch_s = time.perf_counter() - t_disp
+            telemetry.span_event("step_dispatch", dispatch_s,
+                                 step=start_step + i)
+            if watchdog is not None:
+                watchdog.observe_step(start_step + i,
+                                      data_wait_s + dispatch_s,
+                                      data_wait_s=data_wait_s)
             epoch_metrics = add_metrics(epoch_metrics, metrics)
             steps_done = i + 1
             # sample count is host-known (sampler math), no device fetch:
             if samples_per_step is not None:
-                meter.update(samples_per_step[min(i, len(samples_per_step) - 1)])
+                n = samples_per_step[min(i, len(samples_per_step) - 1)]
+                meter.update(n)
+                epoch_samples += n
 
             if (i + 1) % cfg.print_freq == 0:
                 # Host fetch happens only here (print boundary), mirroring the
@@ -1263,6 +1306,10 @@ class Trainer:
                 # Like the reference, the printed loss/acc are the epoch
                 # running averages (ref :230-231).
                 avg_loss, avg_acc = summarize(epoch_metrics)
+                if watchdog is not None:
+                    # the loop's only host fetch — the non-finite-loss
+                    # detector rides it instead of adding a sync
+                    watchdog.observe_loss(start_step + i, avg_loss)
                 rate = meter.rate()
                 mfu = ""
                 if self._flops_per_sample and self._peak_flops_total:
@@ -1280,18 +1327,25 @@ class Trainer:
 
             if stop_fn is not None and stop_fn():
                 break
+            i += 1
 
         # Epoch totals: weighted sums are already global (the batch was the
         # global batch) — the reference needs 3 all-reduces here (ref :251-253);
         # we need none.
-        jax.block_until_ready(epoch_metrics["weight"])
-        epoch_time = time.time() - t_epoch
+        with telemetry.span("device_sync", epoch=epoch):
+            jax.block_until_ready(epoch_metrics["weight"])
+        epoch_time = time.perf_counter() - t_epoch
+        telemetry.counter("epoch_time_s", epoch_time, epoch=epoch)
+        telemetry.counter("steps", steps_done, epoch=epoch)
+        if epoch_samples:
+            telemetry.counter("samples", epoch_samples, epoch=epoch)
         loss, acc = summarize(epoch_metrics)
         return state, loss, acc, epoch_time, steps_done
 
     def evaluate(self, state: TrainState, batches: Iterable) -> Tuple[float, float]:
         """Sharded validation (maps validate, ref :266-300)."""
-        totals = zero_metrics()
-        for batch in batches:
-            totals = add_metrics(totals, self._eval_step(state, batch))
-        return summarize(totals)
+        with telemetry.span("eval"):
+            totals = zero_metrics()
+            for batch in batches:
+                totals = add_metrics(totals, self._eval_step(state, batch))
+            return summarize(totals)

@@ -198,6 +198,18 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="capture a jax.profiler trace into this directory")
     parser.add_argument("--profile-steps", default="10,20", type=str,
                         help="start,stop step of the profiled window")
+    parser.add_argument("--no-telemetry", action="store_true",
+                        help="disable the structured run telemetry stream "
+                             "(telemetry_rank0.jsonl in --output-dir, "
+                             "process 0 only) and the flight recorder. "
+                             "Telemetry is host-side only and never "
+                             "changes training numerics (PARITY.md)")
+    parser.add_argument("--telemetry-abort", action="store_true",
+                        help="turn the anomaly watchdog's abort hook ON: "
+                             "a detected non-finite loss / step-time spike "
+                             "/ loader stall raises instead of only "
+                             "emitting an `anomaly` event (under "
+                             "--max-restarts that means restore+replay)")
 
     return parser.parse_args(argv)
 

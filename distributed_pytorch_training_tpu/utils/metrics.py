@@ -12,6 +12,7 @@ contract, SURVEY.md §5):
 
 from __future__ import annotations
 
+import os
 import time
 from pathlib import Path
 from typing import Optional
@@ -33,7 +34,13 @@ class MetricsCSV:
 
     def append(self, epoch: int, train_loss: float, train_acc: float,
                val_loss: float, val_acc: float, epoch_time: float) -> None:
-        """One row per epoch (ref :380-384; formats match exactly)."""
+        """One row per epoch (ref :380-384; formats match exactly).
+
+        Durable per row: flush + fsync before the handle closes, so a
+        crash/SIGKILL right after an epoch completes (the chaos faults
+        make that a routine scenario) cannot drop the row of an epoch
+        whose work was already fully paid for. One fsync per EPOCH is
+        noise; losing an epoch's row silently is not."""
         if not is_main_process():
             return
         with self.path.open("a") as f:
@@ -41,22 +48,28 @@ class MetricsCSV:
                 f"{epoch + 1},{train_loss:.4f},{train_acc:.2f},"
                 f"{val_loss:.4f},{val_acc:.2f},{epoch_time:.4f}\n"
             )
+            f.flush()
+            os.fsync(f.fileno())
 
 
 class ThroughputMeter:
     """Windowed samples/s (ref :192-193, :224-235): accumulate wall time and
-    global sample counts, read+reset at print boundaries."""
+    global sample counts, read+reset at print boundaries.
+
+    Timed with ``time.perf_counter()`` — monotonic. ``time.time()`` is
+    wall-clock and steps under NTP corrections, so one adjustment inside a
+    window would corrupt the published samples/s (even negative dt)."""
 
     def __init__(self):
         self.reset()
 
     def reset(self) -> None:
-        self._t0 = time.time()
+        self._t0 = time.perf_counter()
         self._samples = 0
 
     def update(self, n_global_samples: int) -> None:
         self._samples += n_global_samples
 
     def rate(self) -> float:
-        dt = time.time() - self._t0
+        dt = time.perf_counter() - self._t0
         return self._samples / dt if dt > 0 else 0.0

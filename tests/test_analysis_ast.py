@@ -374,6 +374,116 @@ class TestPallasCallInOpsOnly:
 # ---------------------------------------------------------------------------
 
 
+class TestTelemetryEmitOutsideTraced:
+    RULE = ["telemetry-emit-outside-traced"]
+
+    def test_mutation_every_import_form_flags(self, tmp_path):
+        header = "import jax\n"
+        footer = "jax.jit(step)\n"
+        for body in (
+            # absolute package import, attribute call
+            "from distributed_pytorch_training_tpu import telemetry\n"
+            "def step(x):\n    telemetry.counter('bad', 1)\n    return x\n",
+            # relative module import (the repo's own idiom)
+            "from .. import telemetry\n"
+            "def step(x):\n    telemetry.span_event('bad', 0.1)\n"
+            "    return x\n",
+            # member from-import, relative
+            "from ..telemetry import span_event\n"
+            "def step(x):\n    span_event('bad', 0.1)\n    return x\n",
+            # member from a submodule, absolute, aliased
+            "from distributed_pytorch_training_tpu.telemetry.recorder "
+            "import counter as c\n"
+            "def step(x):\n    c('bad', 1)\n    return x\n",
+            # plain-import alias
+            "import distributed_pytorch_training_tpu.telemetry as tel\n"
+            "def step(x):\n    tel.emit('event', 'bad')\n    return x\n",
+            # unaliased dotted import, full-path call
+            "import distributed_pytorch_training_tpu.telemetry\n"
+            "def step(x):\n"
+            "    distributed_pytorch_training_tpu.telemetry.emit('e', 'b')\n"
+            "    return x\n",
+        ):
+            findings = _lint(tmp_path, header + body + footer,
+                             rules=self.RULE)
+            assert _rules_of(findings) == set(self.RULE), \
+                f"did not flag: {body!r}"
+
+    def test_shard_map_body_flags_too(self, tmp_path):
+        src = """
+            import jax
+            from distributed_pytorch_training_tpu.parallel import shard_map
+            from .. import telemetry
+            def body(x):
+                telemetry.gauge('depth', 1)
+                return x
+            f = shard_map(body, None, in_specs=(), out_specs=())
+        """
+        findings = _lint(tmp_path, src, rules=self.RULE)
+        assert _rules_of(findings) == set(self.RULE)
+
+    def test_host_side_emission_is_clean(self, tmp_path):
+        """The instrumented loop's real shape: spans AROUND the dispatched
+        step (train_epoch is not traced) never flag, nor do docstring
+        mentions inside traced bodies."""
+        src = '''
+            import jax
+            from .. import telemetry
+            def _train_step_impl(state, batch):
+                """telemetry.counter is forbidden here (a mention, not a
+                call)."""
+                return state
+            step = jax.jit(_train_step_impl)
+            def train_epoch(state, batches):
+                for batch in batches:
+                    with telemetry.span("step_dispatch"):
+                        state = step(state, batch)
+                telemetry.counter("steps", 1)
+                return state
+        '''
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+
+    def test_unaliased_dotted_import_does_not_taint_package_root(
+            self, tmp_path):
+        """`import pkg.telemetry` binds only the root name `pkg` — a call
+        to pkg.parallel.psum(...) inside a traced body is NOT a telemetry
+        emit (the root-alias false positive the dotted-prefix matching
+        exists to prevent)."""
+        src = (
+            "import jax\n"
+            "import distributed_pytorch_training_tpu.telemetry\n"
+            "def step(x):\n"
+            "    return distributed_pytorch_training_tpu.parallel"
+            ".collectives.psum(x, axis)\n"
+            "jax.jit(step)\n")
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+
+    def test_unrelated_telemetry_name_is_clean(self, tmp_path):
+        """A user-defined object that happens to be NAMED telemetry (no
+        import binding it to the package) is not the rule's business."""
+        src = """
+            import jax
+            class Telemetry:
+                def counter(self, *a): ...
+            telemetry = Telemetry()
+            def step(x):
+                return x
+            jax.jit(step)
+            telemetry.counter('outside', 1)
+        """
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+
+    def test_per_line_suppression_honored(self, tmp_path):
+        src = (
+            "import jax\nfrom .. import telemetry\n"
+            "def step(x):\n"
+            "    telemetry.counter('x', 1)  "
+            "# analysis: disable=telemetry-emit-outside-traced\n"
+            "    return x\n"
+            "jax.jit(step)\n")
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+
+
 class TestEngine:
     def test_suppression_comment_skips_finding(self, tmp_path):
         src = """

@@ -624,6 +624,11 @@ def test_chaos_cli_recovers_and_verifies_parity(tmp_path, capsys):
     assert stats["restarts"] == 1
     assert stats["faults_fired"] == ["crash@step=2"]
     assert stats["fence_violations"] == 0
+    # the flight recorder's chaos contract (ISSUE 8): the injected fault
+    # left a parseable postmortem whose cause quotes the fault label
+    assert stats["flights_ok"] is True
+    assert any("crash@step=2" in (f["cause"] or "")
+               for f in stats["flights"])
 
 
 @pytest.mark.slow
@@ -640,6 +645,13 @@ def test_chaos_cli_full_default_schedule(tmp_path, capsys):
         "crash@step=3", "torn_ckpt@save=2", "crash_during_save@save=2",
         "sigterm@step=6"}
     assert stats["faults_unfired"] == []
+    # EVERY fault in the default schedule leaves a parseable flight whose
+    # cause matches the injected fault (the ISSUE 8 acceptance bar)
+    assert stats["flights_ok"] is True
+    causes = [f["cause"] or "" for f in stats["flights"]]
+    for sig in ("crash@step=3", "crash_during_save@save=2",
+                "torn_checkpoint", "sigterm"):
+        assert any(sig in c for c in causes), (sig, causes)
 
 
 def test_resilience_console_script_declared():

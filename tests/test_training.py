@@ -504,3 +504,80 @@ class TestStepProfilerLifecycle:
             assert not prof._active
         prof.close()  # double close is safe
         assert not prof._active
+
+    @pytest.fixture
+    def counted_profiler(self, monkeypatch):
+        """jax.profiler start/stop replaced by counters: these edge-case
+        tests assert session bookkeeping, not trace contents — and a
+        start/stop imbalance must fail the test, not poison the process's
+        real profiler for every later test."""
+        calls = {"start": 0, "stop": 0}
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda log_dir, **kw: calls.__setitem__(
+                "start", calls["start"] + 1))
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: calls.__setitem__("stop", calls["stop"] + 1))
+        return calls
+
+    def test_window_entirely_past_end_of_run(self, tmp_path,
+                                             counted_profiler):
+        """A --profile-steps window the run never reaches (short run, or a
+        preemption before the window): the close() path must be a no-op —
+        no session opened, none closed, no crash."""
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+
+        with StepProfiler(str(tmp_path / "never"), 100, 110) as prof:
+            for step in range(5):  # run ends long before step 100
+                prof(step)
+        assert counted_profiler == {"start": 0, "stop": 0}
+        assert not prof._active and not prof._done
+
+    def test_run_ends_inside_window_closes_once(self, tmp_path,
+                                                counted_profiler):
+        """End-of-run INSIDE the window: __exit__ must stop the open
+        session exactly once (close is the stop path, and a second close
+        must not double-stop)."""
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+
+        with StepProfiler(str(tmp_path / "mid"), 2, 50) as prof:
+            for step in range(5):  # enters the window, never reaches 50
+                prof(step)
+            assert prof._active
+        assert counted_profiler == {"start": 1, "stop": 1}
+        prof.close()
+        assert counted_profiler == {"start": 1, "stop": 1}
+
+    def test_restart_mid_window_no_double_start(self, tmp_path,
+                                                counted_profiler):
+        """The Supervisor-restart shape: a step failure fires mid-window,
+        the step counter replays from the restore point, and the SAME
+        profiler keeps being called (train.py ignores --profile-dir under
+        --max-restarts precisely because a replayed window would lie — but
+        the object must still never leak a session or start_trace twice).
+        The restart replays steps whose _seen indices re-enter the window:
+        _active guards the re-entry, _done guards re-arming after stop."""
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+
+        with StepProfiler(str(tmp_path / "restart"), 2, 6) as prof:
+            with pytest.raises(RuntimeError, match="injected"):
+                for step in range(8):
+                    prof(step)  # enters the window at _seen == 2
+                    if step == 3:
+                        raise RuntimeError("injected step failure")
+            assert counted_profiler == {"start": 1, "stop": 0}
+            # the supervisor restores and the epoch replays: the hook keeps
+            # firing; _seen advances through the stop boundary
+            for step in range(8):
+                prof(step)
+        # ONE session start, ONE stop — the replay neither restarted the
+        # trace nor left it open at exit
+        assert counted_profiler == {"start": 1, "stop": 1}
+        assert prof._done and not prof._active

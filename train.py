@@ -103,9 +103,23 @@ def main(argv=None):
     from distributed_pytorch_training_tpu.training.preemption import (
         PreemptionGuard,
     )
+    from distributed_pytorch_training_tpu import telemetry
+
     guard = PreemptionGuard.install()
     try:
         _run(args, guard)
+    except BaseException as e:
+        # The flight recorder's train.py exit path: ANY abnormal exit
+        # (unhandled exception, deathwatch sys.exit) leaves a postmortem
+        # flight_<ts>.json with the last events + cause. Done here rather
+        # than via sys.excepthook so it runs BEFORE the finally below can
+        # tear telemetry down. Clean SystemExit(0) is not abnormal.
+        if not (isinstance(e, SystemExit) and e.code in (0, None)):
+            telemetry.flush_flight(
+                cause=f"{type(e).__name__}: {e}",
+                detail="train.py abnormal exit",
+                rc=e.code if isinstance(e, SystemExit) else 1)
+        raise
     finally:
         # The hard-exit deadline must not outlive this invocation: an
         # embedder (sweep / notebook) that catches a failure mid-preemption
@@ -113,6 +127,7 @@ def main(argv=None):
         # later with no warning. Normal completion disarms after cleanup
         # inside _run; this is the exception path.
         guard.disarm()
+        telemetry.reset()  # close the JSONL (fsync) and drop the global
 
 
 def _log_save_blocked(ckpt) -> None:
@@ -141,6 +156,16 @@ def _run(args, guard):
         log_main(f"CHAOS: fault plan armed: {args.chaos}")
 
     ctx = setup_distributed()  # ref :318
+    # Structured run telemetry (telemetry/): process-0-only JSONL stream in
+    # the output dir + the in-memory ring the flight recorder flushes on
+    # abnormal exits. Host-side only — PARITY.md pins that the lowered HLO
+    # is identical with telemetry on or off.
+    from distributed_pytorch_training_tpu import telemetry
+    if not args.no_telemetry and ctx.is_main:
+        telemetry.configure(
+            str(Path(args.output_dir) / "telemetry_rank0.jsonl"),
+            meta={"entry": "train.py", "model": args.model,
+                  "mesh": args.mesh, "chaos": args.chaos or ""})
     # Relay-tunnel deathwatch (resilience/heartbeat.py, the layer bench.py
     # seeded): opt-in via DPT_RELAY_PORTS — on the tunneled single-chip
     # environment a dead relay turns every RPC into an unbounded
@@ -454,6 +479,13 @@ def _run(args, guard):
                  f"wire={args.wire_dtype}, overlap="
                  f"{'off' if args.no_overlap_grad_sync else 'on'}")
 
+    if not args.no_telemetry:
+        # anomaly watchdog fed by train_epoch's host-side timings + the
+        # print-boundary losses; abort hook off unless asked (with
+        # --max-restarts an abort is a restartable failure: restore+replay)
+        trainer.watchdog = telemetry.AnomalyWatchdog(
+            abort=args.telemetry_abort)
+
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
     n_params = state.param_count()
@@ -482,6 +514,23 @@ def _run(args, guard):
         log_main(f"FSDP plan: {len(lp.groups)} layer gather group(s), "
                  f"{mb:.1f} MB padded fp32 params "
                  f"({mb / n_batch_shards:.1f} MB/replica at rest)")
+    if telemetry.is_configured() and n_batch_shards > 1 and not args.zero1:
+        # setup-time wire accounting counters (grad_sync/FSDP plans) —
+        # the per-tier byte substrate `telemetry summary` reports.
+        # zero1's split wire (compressed scatter + exact param gather) is
+        # outside wire_bytes_for_config's conventions — omitted, exactly
+        # as the bench harness omits it
+        from distributed_pytorch_training_tpu.parallel.grad_sync import (
+            emit_wire_accounting,
+        )
+        # fsdp states hold flat-sharded leaves; their padded totals match
+        # the model-shaped ones (the harness records them the same way)
+        emit_wire_accounting(
+            state.params,
+            dict(wire_dtype=args.wire_dtype,
+                 bucket_cap_mb=args.bucket_cap_mb,
+                 fsdp_explicit=args.fsdp_explicit),
+            n_batch_shards)
 
     # MFU in the step log (TPU only — needs a known chip peak): analytic
     # matmul/conv FLOPs of one train step, traced once on a peeked batch.
@@ -653,6 +702,9 @@ def _run(args, guard):
                 # Preempted MID-epoch: persist (epoch, step) immediately — a
                 # resume replays nothing (the r3 story lost up to an epoch,
                 # VERDICT r3 #5). No CSV row: the epoch is incomplete.
+                telemetry.flush_flight(
+                    cause=f"preemption (sigterm) drained at epoch {epoch} "
+                          f"step {abs_step}", rc=0)
                 if ckpt:
                     ckpt.save(epoch * steps_per_epoch + abs_step, state,
                               wait=True, epoch=epoch, step_in_epoch=abs_step)
@@ -674,11 +726,25 @@ def _run(args, guard):
                 f"Epoch time: {epoch_time:.2f}s"
             )
             csv.append(epoch, train_loss, train_acc, val_loss, val_acc, epoch_time)
+            if telemetry.is_configured() and \
+                    jax.tree_util.tree_leaves(state.grad_sync):
+                # int8-wire error-feedback health: the carried residual's
+                # global norm (epoch boundary — a host fetch happens here
+                # anyway). A norm that grows without bound means the
+                # telescoping sum stopped telescoping.
+                sq = sum(float(jnp.vdot(r.astype(jnp.float32),
+                                        r.astype(jnp.float32)))
+                         for r in jax.tree_util.tree_leaves(state.grad_sync))
+                telemetry.gauge("ef_residual_norm", float(np.sqrt(sq)),
+                                epoch=epoch)
 
             if ckpt and (epoch + 1) % args.checkpoint_every == 0:
                 ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1)
 
             if guard.should_stop:
+                telemetry.flush_flight(
+                    cause=f"preemption (sigterm) drained at epoch boundary "
+                          f"{epoch + 1}", rc=0)
                 if ckpt:
                     if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
                         ckpt.save((epoch + 1) * steps_per_epoch, state,
