@@ -281,6 +281,45 @@ def check_impure_in_traced(ctx: FileContext) -> List[Finding]:
 _SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
 
 
+def _scan_sync_calls(ctx: FileContext, fndefs, rule_name: str,
+                     scope_desc: str, cost: str) -> List[Finding]:
+    """The shared sync-call detector behind `no-host-sync-in-step` and
+    `no-host-sync-in-decode`: `.item()` / `_SYNC_CALLS` / `float()`/`int()`
+    on non-constants inside the given function defs. ONE detector — a
+    future extension (e.g. catching `np.asarray` fetches) lands in both
+    rules by construction instead of drifting between copies.
+    ``scope_desc`` names the scanned region in messages ("step path" /
+    "decode loop"); ``cost`` names what one sync costs ("per-step" /
+    "per-token")."""
+    out: List[Finding] = []
+    seen: Set[int] = set()
+    for fndef in fndefs:
+        for node in ast.walk(fndef):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    rule_name, f".item() inside {scope_desc} "
+                    f"`{fndef.name}` — a {cost} device sync",
+                    ctx.loc(node)))
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved in _SYNC_CALLS:
+                out.append(Finding(
+                    rule_name, f"{resolved}() inside {scope_desc} "
+                    f"`{fndef.name}` — a {cost} device sync",
+                    ctx.loc(node)))
+            elif resolved in ("float", "int") and node.args and \
+                    not isinstance(node.args[0], ast.Constant):
+                out.append(Finding(
+                    rule_name, f"{resolved}() on a device value inside "
+                    f"{scope_desc} `{fndef.name}` — forces a host fetch",
+                    ctx.loc(node)))
+    return out
+
+
 @rule("no-host-sync-in-step", "ast",
       "no .item()/float()/device_get syncs inside training/loop.py step "
       "paths",
@@ -291,36 +330,41 @@ _SYNC_CALLS = ("jax.device_get", "jax.block_until_ready")
 def check_host_sync_in_step(ctx: FileContext) -> List[Finding]:
     if not ctx.relpath.endswith("training/loop.py"):
         return []
-    name = "no-host-sync-in-step"
     step_names = {n.name for n in ast.walk(ctx.tree)
                   if isinstance(n, ast.FunctionDef)
                   and (n.name.endswith("_step") or
                        n.name.endswith("_step_impl"))}
-    out: List[Finding] = []
-    seen: Set[int] = set()
-    for fndef in _traced_defs(ctx, extra_names=step_names):
-        for node in ast.walk(fndef):
-            if not isinstance(node, ast.Call) or id(node) in seen:
-                continue
-            seen.add(id(node))
-            if isinstance(node.func, ast.Attribute) and \
-                    node.func.attr == "item" and not node.args:
-                out.append(Finding(
-                    name, f".item() inside step path `{fndef.name}` — a "
-                    "per-step device sync", ctx.loc(node)))
-                continue
-            resolved = ctx.resolve(node.func)
-            if resolved in _SYNC_CALLS:
-                out.append(Finding(
-                    name, f"{resolved}() inside step path `{fndef.name}` — "
-                    "a per-step device sync", ctx.loc(node)))
-            elif resolved in ("float", "int") and node.args and \
-                    not isinstance(node.args[0], ast.Constant):
-                out.append(Finding(
-                    name, f"{resolved}() on a device value inside step "
-                    f"path `{fndef.name}` — forces a host fetch",
-                    ctx.loc(node)))
-    return out
+    return _scan_sync_calls(ctx, _traced_defs(ctx, extra_names=step_names),
+                            "no-host-sync-in-step", "step path", "per-step")
+
+
+# The serving decode hot loop's home and function names (serving/engine.py
+# `generate`, plus anything a refactor names *_decode_loop). One host fetch
+# per BATCH is the design (after the last step, in serve_tokens); a fetch
+# inside the loop stalls the device once per generated TOKEN.
+_DECODE_LOOP_FILE = "serving/engine.py"
+
+
+def _is_decode_loop_name(name: str) -> bool:
+    return name == "generate" or name.endswith("_decode_loop")
+
+
+@rule("no-host-sync-in-decode", "ast",
+      "no .item()/float()/device_get syncs inside the serving decode loop "
+      "(serving/engine.py generate)",
+      "the decode loop runs one compiled step per generated token with "
+      "every chained value (token, positions, cache) staying on device; "
+      "a host fetch creeping in serializes the device per TOKEN — the "
+      "training loop's .item() anti-pattern, multiplied by max_new_tokens "
+      "per request.")
+def check_host_sync_in_decode(ctx: FileContext) -> List[Finding]:
+    if not ctx.relpath.endswith(_DECODE_LOOP_FILE):
+        return []
+    loops = [n for n in ast.walk(ctx.tree)
+             if isinstance(n, ast.FunctionDef)
+             and _is_decode_loop_name(n.name)]
+    return _scan_sync_calls(ctx, loops, "no-host-sync-in-decode",
+                            "decode loop", "per-token")
 
 
 # ---------------------------------------------------------------------------

@@ -133,6 +133,11 @@ class Contract:
     metric traffic — sized to the tiny contract model, NOT the 8192 default
     of production censuses. ``min_shards`` gates configs that only engage
     on a multi-shard mesh (zero1 / grad_sync passthrough convention).
+    ``kind`` selects the evaluator: "train" lowers a Trainer step
+    (`hlo_rules._tiny_lm_setup`); "serving" lowers the inference engine's
+    KV-cache decode step (`hlo_rules.evaluate_serving_contract`) — the
+    decode-step contract of serving/ (ISSUE 10), run by the same tier-1
+    ``analysis check`` gate.
     """
 
     name: str
@@ -140,6 +145,7 @@ class Contract:
     config: Dict[str, Any] = dataclasses.field(default_factory=dict)
     min_elements: int = 128
     min_shards: int = 1
+    kind: str = "train"
 
 
 # The canonical matrix (ISSUE 3): dp, zero1, grad_sync x wire dtypes,
@@ -210,6 +216,19 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "fp32, per-layer census unchanged",
              config=dict(fsdp_explicit=True, wire_dtype="int8_multihop"),
              min_shards=2),
+    # The serving decode-step contract (ISSUE 10): the inference engine's
+    # one-token KV-cache step must carry NO host transfers (a callback in
+    # the decode loop stalls every generated token) and must DONATE the
+    # cache (without the alias table every step copies the full
+    # (rows, bucket + max_new, heads, head_dim) k/v — a per-token memory
+    # tax that compounds with batch). The zero-recompile half of the
+    # decode contract is runtime behavior, pinned by the compile-count
+    # census in tests/test_serving.py and asserted by `serving bench`.
+    Contract("serving_decode",
+             "serving KV-cache decode: no host transfers, cache donated "
+             "in place (serving/engine.py lower_decode)",
+             config=dict(serving_decode=True, donate_state=True),
+             kind="serving"),
 )
 
 

@@ -639,6 +639,36 @@ _HOST_TRANSFER_RE = re.compile(
     r"|custom_call_target=\"[^\"]*(?:callback|host_|HostCallback)[^\"]*\"")
 
 
+# one alias-table entry looks like `{3}: (31, {}, may-alias)`; counting the
+# `{out}: (param` heads counts aliased buffers
+_ALIAS_ENTRY_RE = re.compile(r"\{\d+\}:\s*\(\d+")
+
+
+@rule("decode-cache-donated", "hlo",
+      "the serving decode step aliases EVERY KV-cache buffer in place",
+      "the decode hot loop donates its cache (serving/engine.py); if any "
+      "per-block k/v buffer falls out of the alias table, every generated "
+      "token copies that full (rows, bucket+max_new, heads, head_dim) "
+      "buffer — a per-token memory+bandwidth tax the presence-only "
+      "donation rule cannot see (one surviving alias entry satisfies it).")
+def check_decode_cache_donated(a: StepArtifacts) -> List[Finding]:
+    if not a.config.get("serving_decode"):
+        return []
+    expect = int(a.config.get("decode_cache_leaves", 0))
+    # the table nests braces (`{0}: (28, {}, may-alias), ...`), so the
+    # region ends at the first `)` directly followed by the closing `}`
+    m = re.search(r"input_output_alias=\{(.*?\))\s*\}", a.optimized_text,
+                  re.DOTALL)
+    entries = len(_ALIAS_ENTRY_RE.findall(m.group(1))) if m else 0
+    if entries < expect:
+        return [Finding(
+            "decode-cache-donated",
+            f"decode step aliases {entries} of the {expect} KV-cache "
+            "buffers — the un-aliased ones are copied on every generated "
+            "token", a.name)]
+    return []
+
+
 @rule("no-host-transfer", "hlo",
       "no host transfers inside the compiled step",
       "a host callback or infeed/outfeed in the step serializes the device "
@@ -662,7 +692,12 @@ def check_no_host_transfer(a: StepArtifacts) -> List[Finding]:
 def check_dp_sync_present(a: StepArtifacts) -> List[Finding]:
     if (a.zero1_engaged or a.grad_sync_engaged or a.fsdp_engaged
             or a.n_shards <= 1
-            or int(a.config.get("grad_accum", 1)) > 1):
+            or int(a.config.get("grad_accum", 1)) > 1
+            # serving steps carry no gradients at all — this rule's floor
+            # guard is about the TRAIN step's reducer, not a scoping knob
+            # to relax: an inference forward with an all-reduce would be
+            # the bug, not the absence of one
+            or a.config.get("serving_decode")):
         # grad-accum keeps sync inside a scan; count it only on the plain arm
         return []
     census = weight_update_census(a.optimized_text, a.min_elements)
@@ -737,6 +772,70 @@ def replicated_large_buffers(tree: Any, min_elements: int
     return tuple(out)
 
 
+def serving_artifacts(engine, bucket: int,
+                      name: str = "serving_decode") -> StepArtifacts:
+    """StepArtifacts of one serving engine's compiled KV-cache decode step
+    — the serving sibling of the train-step snapshot. ``decode_cache_leaves``
+    carries the cache's leaf count (2 per block: k and v) so
+    `decode-cache-donated` can demand the WHOLE cache aliased, not just
+    some buffer."""
+    import jax
+
+    from ..parallel.mesh import batch_shard_count
+
+    lowered = engine.lower_decode(bucket)
+    optimized = lowered.compile().as_text()
+    try:
+        preopt = preopt_hlo_text(lowered)
+    except Exception:  # pragma: no cover - backend without HLO dialect
+        preopt = None
+    return StepArtifacts(
+        name=name,
+        optimized_text=optimized,
+        preopt_text=preopt,
+        config={"serving_decode": True, "donate_state": True,
+                "decode_cache_leaves": 2 * engine.model.depth},
+        n_shards=batch_shard_count(engine.mesh),
+        backend=jax.default_backend(),
+    )
+
+
+def evaluate_serving_contract(contract: Contract,
+                              mesh=None) -> StepArtifacts:
+    """Lower the tiny serving engine's decode step and snapshot artifacts —
+    the ``kind="serving"`` arm of `evaluate_contract`. The tiny engine is
+    the contract model's shape class (2-block GPT-2) behind the REAL
+    engine code path (serving/engine.py lower_decode), so what the matrix
+    checks is what serving ships."""
+    import jax
+    import numpy as np
+
+    from ..models.gpt2 import GPT2LMHead
+    from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
+    from ..serving.engine import InferenceEngine, ServeConfig
+
+    if mesh is None:
+        mesh = build_mesh(MeshSpec(), devices=jax.devices())
+    n_shards = batch_shard_count(mesh)
+    if n_shards < contract.min_shards:
+        raise ValueError(
+            f"contract {contract.name!r} needs >= {contract.min_shards} "
+            f"batch shards (got {n_shards})")
+    model = GPT2LMHead(vocab_size=64, hidden_dim=32, depth=2, num_heads=2,
+                       max_position=32)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+                        train=False)["params"]
+    engine = InferenceEngine(
+        model, mesh, ServeConfig(buckets=(8,), rows=max(n_shards, 2),
+                                 max_new_tokens=4), params)
+    artifacts = serving_artifacts(engine, bucket=8, name=contract.name)
+    return dataclasses.replace(
+        artifacts, config={**artifacts.config, **contract.config,
+                           "decode_cache_leaves":
+                           artifacts.config["decode_cache_leaves"]},
+        min_elements=contract.min_elements)
+
+
 def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     """Lower + compile one contract's config on `mesh` (default: a pure-DP
     mesh over all local devices) and snapshot the artifacts the rules read.
@@ -744,13 +843,17 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     Raises ValueError when the mesh has fewer batch shards than the
     contract needs (zero1/grad_sync are identity passthroughs there —
     evaluating the contract would vacuously pass; the caller decides
-    whether that is a skip or an error).
+    whether that is a skip or an error). ``kind="serving"`` contracts
+    route to `evaluate_serving_contract` (the inference engine's decode
+    step instead of a Trainer step).
     """
     import jax
 
     from ..parallel.grad_sync import build_bucket_plan
     from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
 
+    if contract.kind == "serving":
+        return evaluate_serving_contract(contract, mesh=mesh)
     if mesh is None:
         mesh = build_mesh(MeshSpec(), devices=jax.devices())
     n_shards = batch_shard_count(mesh)

@@ -1,4 +1,18 @@
-"""Pack a class-folder image tree into the framework's on-disk layout.
+"""Pack ragged data into the framework's static device shapes.
+
+Two packers live here, one per direction of the data path:
+
+* **Offline image packing** (`pack_images`, the module CLI): a
+  torchvision-style ImageFolder tree into one packed uint8 `.npy` per
+  split — decode/resize once, memory-mapped row access forever after.
+* **Online sequence packing** (`bucket_for` / `pack_token_rows` /
+  `unpack_token_rows`): a RAGGED batch of token sequences (serving
+  requests, variable prompt lengths) into ONE static (rows, bucket)
+  int32 matrix plus per-row lengths/weights. The bucket ladder is the
+  compile-once contract: XLA compiles one program per (rows, bucket)
+  shape, and every request thereafter reuses it — never a
+  shape-of-the-request recompile. The serving engine
+  (serving/batching.py) drains its request queue through these.
 
 The torchvision-style ImageFolder tree the reference ecosystem uses
 (`train/<class>/*.JPEG`, ref train_ddp.py:103-119's dataset ancestry) is a
@@ -34,6 +48,75 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+# ---------------------------------------------------------------------------
+# Online sequence packing: ragged request batches -> static bucket shapes
+# ---------------------------------------------------------------------------
+
+
+def bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket >= ``length`` from the (sorted-ascending) bucket
+    ladder. One compiled program exists per bucket, so this choice decides
+    which executable a request rides — and the padding it pays (at most to
+    the next rung). A length above the top rung raises: silently truncating
+    a request would serve logits for a prompt nobody sent."""
+    if length <= 0:
+        raise ValueError(f"sequence length must be >= 1, got {length}")
+    for b in sorted(buckets):
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        f"sequence length {length} exceeds the largest bucket "
+        f"{max(buckets)} — add a rung to the bucket ladder or reject the "
+        "request upstream")
+
+
+def pack_token_rows(
+    seqs: Sequence[np.ndarray], bucket: int, rows: int, pad_id: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack ragged token sequences into one static (rows, bucket) batch.
+
+    Returns ``(ids, lengths, weight)``: ``ids`` int32 right-padded with
+    ``pad_id`` (right-padding, NOT left: positions 0..len-1 keep the same
+    position embeddings as the training/eval forward, which is what makes
+    fp32 served logits bitwise-comparable to the eval forward), ``lengths``
+    int32 per-row real lengths (0 for the padded filler rows beyond
+    ``len(seqs)``), and ``weight`` fp32 1.0/0.0 per row (the loader
+    convention: filler rows carry weight 0, so any metric path ignores
+    them). Each request is its OWN row — requests are never concatenated
+    into a shared row, so cross-request attention cannot exist by
+    construction; trailing pad positions are masked by the causal
+    structure (no real position ever attends forward into pad).
+    """
+    if len(seqs) > rows:
+        raise ValueError(f"{len(seqs)} sequences do not fit {rows} rows")
+    ids = np.full((rows, bucket), pad_id, np.int32)
+    lengths = np.zeros(rows, np.int32)
+    weight = np.zeros(rows, np.float32)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s)
+        if s.ndim != 1:
+            raise ValueError(f"sequence {i} is not 1-D (shape {s.shape})")
+        if len(s) > bucket:
+            raise ValueError(
+                f"sequence {i} ({len(s)} tokens) exceeds bucket {bucket} — "
+                "route it through bucket_for first")
+        ids[i, : len(s)] = s
+        lengths[i] = len(s)
+        weight[i] = 1.0
+    return ids, lengths, weight
+
+
+def unpack_token_rows(outputs: np.ndarray, lengths: np.ndarray,
+                      n_real: int) -> List[np.ndarray]:
+    """Invert `pack_token_rows` on a per-position output (rows, bucket, ...):
+    per-request arrays with every pad position dropped — the round-trip
+    contract the serving tests pin. ``n_real`` cuts the filler rows."""
+    out = []
+    for i in range(int(n_real)):
+        out.append(np.asarray(outputs[i][: int(lengths[i])]))
+    return out
 
 
 def _resize_center_crop(img, size: int) -> np.ndarray:

@@ -399,6 +399,201 @@ def checkpoint_save_ab(state, base_dir: Optional[str] = None) -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
+                         buckets: Sequence[int] = (16, 32), rows: int = 8,
+                         max_new_tokens: int = 8, serve_dtype: str = "fp32",
+                         model_overrides: Optional[dict] = None,
+                         ckpt_dir: Optional[str] = None,
+                         train_config=None, seed: int = 0,
+                         optimizer: str = "auto", momentum: float = 0.9,
+                         weight_decay: float = 5e-4):
+    """(engine, mesh) for a serving config on a pure-DP mesh — the serving
+    sibling of `build_trainer`, so bench rows and the CLI measure the same
+    engine. Without ``ckpt_dir`` the weights are random-init (a smoke of
+    the serving path, not a served model — the row says so); with it, the
+    newest manifest-verified checkpoint restores through the same template
+    machinery a training resume uses (``train_config`` carries the
+    training run's zero1/fsdp/wire flags when they differ from defaults).
+
+    The restore template's optimizer chain must STRUCTURALLY match the
+    training run's (orbax validates the opt_state tree): the template is
+    built exactly as train.py builds it — ``make_optimizer`` with a
+    callable (constant) schedule and no grad clip — and ``optimizer`` /
+    ``momentum`` / ``weight_decay`` are the knobs that change the chain's
+    structure (a zero momentum/decay drops a transform). "auto" picks the
+    family recipe: adamw for LM models, sgd for vision (train.py's CLI
+    default is sgd everywhere; pass ``optimizer="sgd"`` for an LM trained
+    that way).
+    """
+    from ..models import get_model
+    from ..parallel import MeshSpec, build_mesh
+    from ..serving.engine import InferenceEngine, ServeConfig
+    from ..training.optim import make_optimizer, make_schedule
+
+    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    cfg = ServeConfig(buckets=tuple(buckets), rows=rows,
+                      max_new_tokens=max_new_tokens, serve_dtype=serve_dtype)
+    dtype = jnp.bfloat16 if serve_dtype == "bf16" else jnp.float32
+    if optimizer == "auto":
+        optimizer = "adamw" if is_lm_model(model_name) else "sgd"
+    tx = make_optimizer(optimizer, make_schedule("constant", 0.1),
+                        momentum=momentum, weight_decay=weight_decay)
+    if not is_lm_model(model_name):
+        # --model-overrides applies here too: a resnet trained with
+        # num_classes=100 must be able to build a matching template
+        model = get_model(model_name, dtype=dtype,
+                          **(model_overrides or {}))
+        sample = np.zeros((1, 32, 32, 3), np.float32)
+    else:
+        kwargs = dict(model_overrides or {})
+        need = max(cfg.buckets) + cfg.max_new_tokens
+        kwargs.setdefault("max_position", max(512, need))
+        model = get_model(model_name, dtype=dtype, **kwargs)
+        sample = np.zeros((1, min(cfg.buckets)), np.int32)
+    if ckpt_dir:
+        engine = InferenceEngine.from_checkpoint(
+            ckpt_dir, model, mesh, cfg, tx, sample,
+            train_config=train_config)
+    else:
+        variables = model.init(jax.random.PRNGKey(seed), sample, train=False)
+        engine = InferenceEngine(model, mesh, cfg, variables["params"],
+                                 batch_stats=variables.get("batch_stats"))
+    return engine, mesh
+
+
+def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
+                    offered_rps: float = 16.0,
+                    buckets: Sequence[int] = (16, 32), rows: int = 8,
+                    max_new_tokens: int = 8, serve_dtype: str = "fp32",
+                    devices: Optional[Sequence[jax.Device]] = None,
+                    model_overrides: Optional[dict] = None,
+                    ckpt_dir: Optional[str] = None, seed: int = 0,
+                    optimizer: str = "auto", momentum: float = 0.9,
+                    weight_decay: float = 5e-4,
+                    train_config=None) -> dict:
+    """Serving latency/throughput at FIXED offered load — the serving row
+    of the bench table (`serving bench` prints it).
+
+    A load generator submits ``n_requests`` mixed-length prompts on a
+    deterministic 1/``offered_rps`` cadence into the request queue while
+    the engine worker drains it (continuous batching); per-request latency
+    is submit -> result. Reports p50/p99 latency, achieved request and
+    token throughput, the engine's compile census
+    (``recompiles_after_warmup`` MUST be 0 — the contract the acceptance
+    test asserts), and the served checkpoint's provenance when one was
+    loaded. Offered load is what the schedule ASKS for; ``achieved_rps``
+    is what the engine absorbed — an overloaded engine shows the gap
+    honestly instead of averaging it away.
+    """
+    import threading
+
+    from ..serving.batching import RequestQueue, serve_forever
+
+    devices = list(devices) if devices is not None else jax.devices()
+    engine, mesh = build_serving_engine(
+        devices, model_name, buckets=buckets, rows=rows,
+        max_new_tokens=max_new_tokens, serve_dtype=serve_dtype,
+        model_overrides=model_overrides, ckpt_dir=ckpt_dir, seed=seed,
+        optimizer=optimizer, momentum=momentum,
+        weight_decay=weight_decay, train_config=train_config)
+    if not engine.is_token:
+        # the load generator submits token prompts; an image engine would
+        # crash mid-warmup with a confusing traceback instead of this
+        raise ValueError(
+            f"serving bench drives token models (gpt2/bert); {model_name} "
+            "serves images — use `serving smoke` or engine.serve_images")
+
+    # warmup: compile every bucket AND execute once per bucket, so the
+    # timed window measures steady state — then pin the compile census
+    engine.warmup()
+    rng = np.random.RandomState(seed)
+    # prompt ids from the SERVED model's vocab (overridden CI models
+    # shrink it below the family default lm_vocab reports)
+    vocab = int(getattr(engine.model, "vocab_size", 0)) or 256
+    for b in engine.config.buckets:
+        engine.serve_tokens([rng.randint(0, max(vocab, 2), b)
+                             .astype(np.int32)])
+    compiles_warm = engine.compiles
+
+    lens = [int(rng.randint(1, max(engine.config.buckets) + 1))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, max(vocab, 2), n).astype(np.int32)
+               for n in lens]
+    queue = RequestQueue(engine.config.buckets)
+    stop = threading.Event()
+    worker = threading.Thread(target=serve_forever,
+                              args=(engine, queue, stop), daemon=True)
+    worker.start()
+    gap = 1.0 / max(offered_rps, 1e-9)
+    reqs = []
+    t_start = time.perf_counter()
+    for i, p in enumerate(prompts):
+        # fixed offered load: submit on schedule, never "when ready"
+        lag = t_start + i * gap - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        reqs.append(queue.submit(p))
+    for r in reqs:
+        r.result(timeout=600.0)
+    stop.set()
+    worker.join(timeout=60.0)
+
+    lat_ms = np.array([(r.t_done - r.t_submit) * 1e3 for r in reqs])
+    window_s = max(max(r.t_done for r in reqs) - t_start, 1e-9)
+    recompiles = engine.compiles - compiles_warm
+    row = {
+        "mode": "serving",
+        "model": model_name,
+        "serve_dtype": serve_dtype,
+        "buckets": list(engine.config.buckets),
+        "rows": rows,
+        "max_new_tokens": max_new_tokens,
+        "n_requests": n_requests,
+        "offered_rps": offered_rps,
+        "achieved_rps": round(n_requests / window_s, 2),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "mean_ms": round(float(lat_ms.mean()), 2),
+        # only generating (causal-LM) engines produce tokens; a bert
+        # embedding bench must not report a throughput for tokens that
+        # were never generated
+        **({"tokens_per_sec":
+            round(n_requests * max_new_tokens / window_s, 1)}
+           if engine.is_lm else {}),
+        "compiles": engine.compiles,
+        "recompiles_after_warmup": recompiles,
+        "checkpoint": engine.checkpoint_info,
+    }
+    if serve_dtype == "int8":
+        from ..serving.engine import int8_weight_bytes
+
+        row["weight_bytes"] = int8_weight_bytes(engine._served)
+    # per-arm contract verdict, exactly like the training rows: the decode
+    # step of the largest bucket must keep its promises (no host
+    # transfers, cache donated). Decode exists only for causal LMs; a
+    # bert arm records the skip instead of a spurious error. Best-effort
+    # — observability never kills a measurement.
+    if engine.is_lm:
+        try:
+            from ..analysis.hlo_rules import (
+                check_artifacts, serving_artifacts,
+            )
+
+            artifacts = serving_artifacts(
+                engine, max(engine.config.buckets), name="bench-serving")
+            findings = check_artifacts(artifacts)
+            row["contracts"] = {
+                "pass": not findings,
+                "violations": [f.as_dict() for f in findings]}
+        except Exception as e:  # noqa: BLE001
+            row["contracts"] = {"pass": None,
+                                "error": f"{type(e).__name__}: {e}"}
+    else:
+        row["contracts"] = {"pass": None,
+                            "skipped": "no decode step (not a causal LM)"}
+    return row
+
+
 def measure_config(model_name: str, per_device_batch: int, steps: int,
                    bf16: bool, repeats: int = 3, seq_len: int = 512,
                    image_hw: int = 32, num_classes: int = 10,

@@ -50,14 +50,39 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
     pad_vocab_to_multiple_of: int = 0
 
     @nn.compact
-    def __call__(self, input_ids, attention_mask=None, train: bool = False):
+    def __call__(self, input_ids, attention_mask=None, train: bool = False,
+                 cache=None, cache_positions=None):
+        """Causal LM forward. Three modes, selected by ``cache``:
+
+        * ``cache=None`` (training/eval): the original forward, byte-
+          identical HLO to the pre-cache module (the lowering pin in
+          tests/test_serving.py) — the cache plumbing contributes ZERO ops
+          when off.
+        * prefill (``cache`` given, ``cache_positions=None``): the same
+          causal forward over the (padded) prompt, additionally returning
+          the per-block (k, v) caches filled at slots [0, S). Attention
+          runs over the fresh k/v, so prefill logits ARE the eval
+          forward's logits bit-for-bit (PARITY.md "Serving shares
+          training numerics").
+        * decode (``cache`` + ``cache_positions`` (B,) int32): one new
+          token per row at that row's own position — per-row cache
+          scatter, per-row position embedding, attention over cache slots
+          ``<= position``. Returns (B, 1, vocab) logits for the NEXT
+          token. Rows at different prompt lengths decode in one batch
+          with no recompile (the positions are traced values).
+
+        With a cache the return value is ``(logits, new_cache)`` where
+        ``new_cache`` matches `init_cache`'s structure.
+        """
         b, s = input_ids.shape
+        decoding = cache is not None and cache_positions is not None
         wte = nn.Embed(self.padded_vocab, self.hidden_dim, dtype=self.dtype,
                        param_dtype=self.param_dtype,
                        embedding_init=nn.initializers.normal(stddev=0.02),
                        name="wte")
         x = wte(input_ids)
-        pos_ids = jnp.arange(s)[None, :]
+        pos_ids = (cache_positions[:, None] if decoding
+                   else jnp.arange(s)[None, :])
         x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
                          param_dtype=self.param_dtype,
                          embedding_init=nn.initializers.normal(stddev=0.01),
@@ -67,8 +92,15 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
         # they get ONLY the padding mask (flash applies it inside the
         # blocks; ring/ulysses raise — their adapters need the XLA path).
         # The XLA einsum path takes the combined causal & padding mask.
+        # Decode attends over the cache: slot j is visible iff j <= this
+        # row's position (later slots are unwritten or prefill pad — both
+        # must stay invisible).
         uses_kernel = self.attention_fn is not dot_product_attention
-        if uses_kernel:
+        if decoding:
+            t = cache[0][0].shape[1]
+            mask = (jnp.arange(t)[None, :]
+                    <= cache_positions[:, None])[:, None, None, :]
+        elif uses_kernel:
             mask = (attention_mask[:, None, None, :].astype(bool)
                     if attention_mask is not None else None)
         else:
@@ -76,9 +108,10 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
             if attention_mask is not None:
                 mask = mask & attention_mask[:, None, None, :].astype(bool)
 
+        new_cache = []
         block_cls = nn.remat(TransformerBlock) if self.remat else TransformerBlock
         for i in range(self.depth):
-            x = block_cls(
+            block = block_cls(
                 num_heads=self.num_heads,
                 head_dim=self.hidden_dim // self.num_heads,
                 mlp_dim=4 * self.hidden_dim, dtype=self.dtype,
@@ -87,12 +120,28 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
                 layernorm_epsilon=self.layernorm_epsilon,
                 attention_fn=self.attention_fn,
                 name=f"block{i}",
-            )(x, mask=mask, deterministic=not train)
+            )
+            if cache is None:
+                x = block(x, mask=mask, deterministic=not train)
+            else:
+                x, c = block(x, mask=mask, deterministic=not train,
+                             cache=cache[i], cache_positions=cache_positions)
+                new_cache.append(c)
 
         x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
         logits = wte.attend(x)  # tied LM head (HF GPT-2 ties wte <-> lm_head)
-        return mask_vocab_padding(logits.astype(jnp.float32), self.vocab_size)
+        logits = mask_vocab_padding(logits.astype(jnp.float32),
+                                    self.vocab_size)
+        return logits if cache is None else (logits, tuple(new_cache))
+
+    def init_cache(self, batch: int, max_len: int):
+        """Zero-filled per-block (k, v) cache: ``depth`` pairs of
+        (batch, max_len, heads, head_dim) arrays in the compute dtype.
+        ``max_len`` = prompt bucket + max new tokens (serving/engine.py)."""
+        z = jnp.zeros((batch, max_len, self.num_heads,
+                       self.hidden_dim // self.num_heads), self.dtype)
+        return tuple((z, z) for _ in range(self.depth))
 
     @staticmethod
     def partition_rules() -> PartitionRules:

@@ -51,11 +51,60 @@ def dot_product_attention(
     return jnp.einsum("bhst,bthd->bshd", weights, v)
 
 
+def decode_dot_product_attention(
+    q: jnp.ndarray,  # (B, 1, H, D) — the single new token
+    k: jnp.ndarray,  # (B, T, H, D) — the KV cache
+    v: jnp.ndarray,  # (B, T, H, D)
+    mask: Optional[jnp.ndarray] = None,  # (B, 1, 1, T), True=attend
+    dtype: Dtype = jnp.float32,
+) -> jnp.ndarray:
+    """`dot_product_attention` for the one-token decode step, formulated so
+    its fp32 output is BITWISE-equal to the corresponding row of the full
+    forward on the CPU mesh (the serving parity pin, PARITY.md).
+
+    Same math, one deliberate difference: the weights x V contraction runs
+    through an explicit `lax.dot_general` with (B, H) batch dims. The
+    einsum form ``bhst,bthd->bshd`` lowers to a GEMV for s=1 whose
+    accumulation order differs from the s=S GEMM's — ~1e-7-level
+    reassociation noise that would break the decode-vs-full bitwise parity
+    contract. The dot_general form accumulates like the GEMM row does
+    (pinned empirically by tests/test_serving.py; the QK^T einsum and the
+    softmax are already row-stable at s=1, so they stay as-is)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(d).astype(np.float32)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1).astype(dtype)  # (B, H, 1, T)
+    out = jax.lax.dot_general(
+        weights, v.transpose(0, 2, 1, 3),
+        (((3,), (2,)), ((0, 1), (0, 1))))  # (B, H, 1, D)
+    return out.transpose(0, 2, 1, 3)
+
+
 class MultiHeadAttention(nn.Module):
     """Self-attention with fused qkv projection.
 
     `attention_fn(q, k, v, mask, dtype)` defaults to the XLA einsum path;
     swap in `ops.flash_attention` / `ops.ring_attention` for long context.
+
+    KV cache (serving/): ``cache=(k, v)`` of shape (B, T, H, D) engages the
+    incremental-decoding path and the call returns ``(out, new_cache)``.
+    Two cache writes exist:
+
+    * prefill (``cache_positions=None``, S > 1 legal): the fresh k/v land
+      in slots [0, S) and attention runs over the FRESH k/v with the
+      caller's (causal) mask — exactly the no-cache computation, so
+      prefill logits are the eval forward's logits bit-for-bit, with the
+      cache fill as a side output.
+    * decode (``cache_positions`` = per-row write index, S == 1): the new
+      token's k/v land at each row's own position (a where-scatter, so
+      rows at different prompt lengths advance independently with no
+      recompile) and attention runs over the UPDATED cache under the
+      caller's per-row validity mask.
+
+    With ``cache=None`` the path is byte-identical to the pre-cache module
+    (pinned by tests/test_serving.py's lowering test).
     """
 
     num_heads: int
@@ -67,20 +116,49 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Callable = dot_product_attention
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic: bool = True):
+    def __call__(self, x, mask=None, deterministic: bool = True,
+                 cache=None, cache_positions=None):
         features = self.num_heads * self.head_dim
         dense = functools.partial(nn.DenseGeneral, dtype=self.dtype,
                                   param_dtype=self.param_dtype,
                                   use_bias=self.use_bias)
         qkv = dense(features=(3, self.num_heads, self.head_dim), name="qkv")(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
-        y = self.attention_fn(q, k, v, mask=mask, dtype=self.dtype)
+        new_cache = None
+        y = None
+        if cache is not None:
+            if self.attention_fn is not dot_product_attention:
+                raise ValueError(
+                    "KV-cache decoding needs the XLA attention path — the "
+                    "kernel attention_fns own their causal structure and "
+                    "take no cache (serve with --attention xla)")
+            ck, cv = cache
+            if cache_positions is None:
+                # prefill: the S fresh rows fill slots [0, S); attention
+                # runs over the FRESH k/v below (the eval computation)
+                new_cache = (
+                    jax.lax.dynamic_update_slice(
+                        ck, k.astype(ck.dtype), (0, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(
+                        cv, v.astype(cv.dtype), (0, 0, 0, 0)))
+            else:
+                # decode: per-row scatter at each row's own position, then
+                # attend over the updated cache (q is the single new token)
+                hit = (jnp.arange(ck.shape[1])[None, :]
+                       == cache_positions[:, None])[:, :, None, None]
+                ck = jnp.where(hit, k.astype(ck.dtype), ck)
+                cv = jnp.where(hit, v.astype(cv.dtype), cv)
+                new_cache = (ck, cv)
+                y = decode_dot_product_attention(q, ck, cv, mask=mask,
+                                                 dtype=self.dtype)
+        if y is None:
+            y = self.attention_fn(q, k, v, mask=mask, dtype=self.dtype)
         if self.dropout_rate and not deterministic:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=False)
         out = nn.DenseGeneral(features=x.shape[-1], axis=(-2, -1),
                               dtype=self.dtype, param_dtype=self.param_dtype,
                               use_bias=self.use_bias, name="out")(y)
-        return out
+        return out if cache is None else (out, new_cache)
 
 
 class MlpBlock(nn.Module):
@@ -116,7 +194,8 @@ class TransformerBlock(nn.Module):
     attention_fn: Callable = dot_product_attention
 
     @nn.compact
-    def __call__(self, x, mask=None, deterministic: bool = True):
+    def __call__(self, x, mask=None, deterministic: bool = True,
+                 cache=None, cache_positions=None):
         ln = functools.partial(nn.LayerNorm, epsilon=self.layernorm_epsilon,
                                dtype=self.dtype, param_dtype=self.param_dtype)
         y = ln(name="ln1")(x)
@@ -124,14 +203,18 @@ class TransformerBlock(nn.Module):
             num_heads=self.num_heads, head_dim=self.head_dim, dtype=self.dtype,
             param_dtype=self.param_dtype, dropout_rate=self.dropout_rate,
             attention_fn=self.attention_fn, name="attn",
-        )(y, mask=mask, deterministic=deterministic)
+        )(y, mask=mask, deterministic=deterministic, cache=cache,
+          cache_positions=cache_positions)
+        new_cache = None
+        if cache is not None:
+            y, new_cache = y
         x = x + y
         y = ln(name="ln2")(x)
         y = MlpBlock(hidden_dim=self.mlp_dim, dtype=self.dtype,
                      param_dtype=self.param_dtype,
                      dropout_rate=self.dropout_rate, name="mlp",
                      )(y, deterministic=deterministic)
-        return x + y
+        return x + y if cache is None else (x + y, new_cache)
 
 
 def padded_vocab_size(vocab_size: int, multiple: int) -> int:

@@ -1,0 +1,26 @@
+"""serving/ — manifest-verified batched inference on the training stack.
+
+The path from a training checkpoint to a served token, built from the
+pieces the training side already ships: ``training/checkpoint.py``'s
+manifest-verified restore for the weights, ``data/pack.py``'s bucket
+ladder for the shapes, ``models/gpt2.py``'s cache-aware forward for
+prefill + KV-cache decode, the grad-sync int8 codec grid for
+weight-at-rest quantization, ``resilience/`` for liveness + drain, and
+``telemetry/`` for the latency story (queue_wait / prefill / decode /
+drain spans).
+
+Entry points: the ``serving`` console script (``smoke`` / ``bench``), or
+`InferenceEngine` + `RequestQueue` directly.
+"""
+
+from .batching import Request, RequestQueue, Result, drain, serve_forever
+from .engine import (
+    InferenceEngine, QuantizedLeaf, ServeConfig, dequantize_params,
+    int8_weight_bytes, quantize_params,
+)
+
+__all__ = [
+    "InferenceEngine", "QuantizedLeaf", "Request", "RequestQueue", "Result",
+    "ServeConfig", "dequantize_params", "drain", "int8_weight_bytes",
+    "quantize_params", "serve_forever",
+]

@@ -1,0 +1,260 @@
+"""``python -m distributed_pytorch_training_tpu.serving`` — serve a
+manifest-verified checkpoint through the batched inference engine.
+
+Also installed as the ``serving`` console script (pyproject.toml).
+
+Commands:
+  smoke [--ckpt-dir D] [--prompt 12,7,99 | --prompt-len N]
+      One-shot: build the engine (restoring the newest verified checkpoint
+      when --ckpt-dir is given; random-init weights otherwise — a smoke of
+      the serving PATH, loudly labeled, never of a served model), serve a
+      handful of synthetic prompts, print the generated tokens and the
+      checkpoint provenance (label + manifest tree_digest).
+  bench [--requests N] [--offered-load RPS] [--json]
+      Latency/throughput at fixed offered load: a deterministic load
+      generator submits mixed-length prompts on a 1/RPS cadence while the
+      engine worker drains the queue (continuous batching); reports
+      p50/p99 latency, achieved request/token throughput, the compile
+      census (zero recompiles after warmup is the contract), and the
+      serving HLO-contract verdict — the serving row of the bench table
+      (experiments/harness.py::measure_serving).
+
+Health/drain: the resilience Deathwatch watches the relay ports exactly as
+train.py's does (opt-in via DPT_RELAY_PORTS); SIGTERM closes the queue,
+DRAINS it (accepted requests complete, new ones are refused), flushes a
+telemetry flight, and exits 0. Any abnormal exit flushes a flight too.
+
+Checkpoint templates: orbax restores against the training run's full
+TrainState structure, so a checkpoint written under --zero1 /
+--fsdp-explicit / an int8 wire needs the same flags here (exactly the
+resume-hint contract train.py documents).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+
+def _parse_buckets(text: str) -> tuple:
+    try:
+        out = tuple(int(b) for b in text.split(",") if b.strip())
+    except ValueError:
+        out = ()
+    if not out:
+        raise SystemExit(f"serving: --buckets expects e.g. '16,32,64', "
+                         f"got {text!r}")
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="serving", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("command", choices=["smoke", "bench"])
+    p.add_argument("--model", default="gpt2_124m")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="serve the newest manifest-verified checkpoint "
+                        "from this directory (omit: random-init smoke)")
+    p.add_argument("--serve-dtype", default="fp32",
+                   choices=["fp32", "bf16", "int8"])
+    p.add_argument("--buckets", default="16,32",
+                   help="prompt-length bucket ladder, e.g. '32,64,128'")
+    p.add_argument("--rows", type=int, default=8,
+                   help="batch rows per engine cycle")
+    p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--model-overrides", default="",
+                   help="architecture overrides, e.g. "
+                        "'hidden_dim=64,depth=2,num_heads=2'")
+    # checkpoint TEMPLATE flags (must mirror the training run's — orbax
+    # validates the TrainState structure, and the optimizer chain's
+    # structure depends on these: see harness.build_serving_engine)
+    p.add_argument("--zero1", action="store_true")
+    p.add_argument("--fsdp-explicit", action="store_true")
+    p.add_argument("--wire-dtype", default="fp32")
+    p.add_argument("--bucket-cap-mb", type=float, default=0.0)
+    p.add_argument("--optimizer", default="auto",
+                   choices=["auto", "sgd", "adamw"],
+                   help="the training run's optimizer (auto: adamw for "
+                        "LMs, sgd for vision — train.py's own default is "
+                        "sgd everywhere)")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weight-decay", type=float, default=5e-4)
+    # smoke
+    p.add_argument("--prompt", default=None,
+                   help="smoke: comma-separated token ids")
+    p.add_argument("--prompt-len", type=int, default=12,
+                   help="smoke: synthetic prompt length when no --prompt")
+    # bench
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--offered-load", type=float, default=16.0,
+                   help="bench: offered request rate (req/s)")
+    p.add_argument("--output-dir", default="./serving_out",
+                   help="telemetry stream + flight directory")
+    p.add_argument("--no-telemetry", action="store_true")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    buckets = _parse_buckets(args.buckets)
+
+    # Standalone CPU runs get the 8-device virtual mesh (the analysis CLI's
+    # recipe — serving shares it so `serving smoke` exercises real
+    # cross-device batch sharding with no TPU).
+    from ..analysis.__main__ import _ensure_test_mesh
+
+    _ensure_test_mesh()
+
+    import jax
+
+    from .. import telemetry
+    from ..resilience.heartbeat import Deathwatch
+    from ..utils.logging import log_main
+
+    if not args.no_telemetry and jax.process_index() == 0:
+        Path(args.output_dir).mkdir(parents=True, exist_ok=True)
+        telemetry.configure(
+            str(Path(args.output_dir) / "telemetry_rank0.jsonl"),
+            meta={"entry": "serving", "model": args.model,
+                  "serve_dtype": args.serve_dtype,
+                  "buckets": list(buckets)})
+    Deathwatch.arm(log=log_main)
+
+    try:
+        return _run(args, buckets)
+    except BaseException as e:
+        # every abnormal serving exit leaves a postmortem flight (the
+        # train.py contract); clean SystemExit(0) is not abnormal
+        if not (isinstance(e, SystemExit) and e.code in (0, None)):
+            telemetry.flush_flight(
+                cause=f"{type(e).__name__}: {e}",
+                detail="serving abnormal exit",
+                rc=e.code if isinstance(e, SystemExit) else 1)
+        raise
+    finally:
+        telemetry.reset()
+
+
+def _run(args, buckets) -> int:
+    import jax
+
+    from .. import telemetry
+    from ..experiments.harness import (
+        build_serving_engine, is_lm_model, lm_vocab, measure_serving,
+    )
+    from ..training import TrainConfig
+    from ..utils.config import parse_model_overrides
+    from ..utils.logging import log_main
+    from .batching import RequestQueue, drain, serve_forever
+
+    overrides = (parse_model_overrides(args.model_overrides)
+                 if args.model_overrides else None)
+    train_config = TrainConfig(
+        seed=0, zero1=args.zero1, fsdp_explicit=args.fsdp_explicit,
+        wire_dtype=args.wire_dtype, bucket_cap_mb=args.bucket_cap_mb)
+
+    if args.command == "bench":
+        row = measure_serving(
+            model_name=args.model, n_requests=args.requests,
+            offered_rps=args.offered_load, buckets=buckets, rows=args.rows,
+            max_new_tokens=args.max_new_tokens,
+            serve_dtype=args.serve_dtype, model_overrides=overrides,
+            ckpt_dir=args.ckpt_dir, seed=args.seed,
+            optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay, train_config=train_config)
+        if args.as_json:
+            print(json.dumps(row, sort_keys=True, default=str))
+        else:
+            toks = (f" ({row['tokens_per_sec']} tok/s)"
+                    if "tokens_per_sec" in row else "")
+            log_main(
+                f"serving bench: {row['model']} [{row['serve_dtype']}] "
+                f"p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms at "
+                f"{row['achieved_rps']}/{row['offered_rps']} req/s{toks}, "
+                f"{row['compiles']} compiles "
+                f"({row['recompiles_after_warmup']} after warmup)")
+            if row.get("contracts", {}).get("pass") is False:
+                log_main(f"serving bench: CONTRACT VIOLATIONS: "
+                         f"{row['contracts']['violations']}")
+        return 0 if row.get("recompiles_after_warmup") == 0 else 1
+
+    # -- smoke ---------------------------------------------------------------
+    engine, mesh = build_serving_engine(
+        jax.devices(), args.model, buckets=buckets, rows=args.rows,
+        max_new_tokens=args.max_new_tokens, serve_dtype=args.serve_dtype,
+        model_overrides=overrides, ckpt_dir=args.ckpt_dir,
+        train_config=train_config, seed=args.seed,
+        optimizer=args.optimizer, momentum=args.momentum,
+        weight_decay=args.weight_decay)
+    if engine.checkpoint_info:
+        info = engine.checkpoint_info
+        log_main(f"serving: checkpoint label={info['label']} "
+                 f"step={info['step']} verified={info['verified']} "
+                 f"tree_digest={info['tree_digest']}")
+    else:
+        log_main("serving: NOTE: random-init weights (no --ckpt-dir) — "
+                 "this smokes the serving path, not a trained model")
+
+    if not engine.is_token:
+        rng = np.random.RandomState(args.seed)
+        logits = engine.serve_images(
+            rng.randint(0, 256, (2, 32, 32, 3)).astype(np.uint8),
+            mean=(0.4914, 0.4822, 0.4465), std=(0.247, 0.243, 0.262))
+        log_main(f"serving smoke: {logits.shape[0]} images -> logits "
+                 f"{logits.shape}, top-1 {logits.argmax(-1).tolist()}")
+        return 0
+
+    if args.prompt:
+        prompts = [np.asarray([int(t) for t in args.prompt.split(",")],
+                              np.int32)]
+    else:
+        rng = np.random.RandomState(args.seed)
+        vocab = lm_vocab(args.model) if is_lm_model(args.model) else 256
+        prompts = [rng.randint(0, vocab, n).astype(np.int32)
+                   for n in (args.prompt_len, max(args.prompt_len // 2, 1),
+                             min(args.prompt_len * 2, max(buckets)))]
+
+    # the production wiring in miniature: queue + worker thread + SIGTERM
+    # drain — smoke exercises the same path a real frontend would use
+    queue = RequestQueue(buckets)
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        log_main("serving: SIGTERM — draining the queue, then exiting")
+        stop.set()
+        telemetry.flush_flight(cause="sigterm drain",
+                               detail="serving graceful shutdown", rc=0)
+
+    prev = signal.signal(signal.SIGTERM, on_sigterm)
+    try:
+        worker = threading.Thread(target=serve_forever,
+                                  args=(engine, queue, stop),
+                                  kwargs={"log": log_main}, daemon=True)
+        worker.start()
+        reqs = [queue.submit(p) for p in prompts]
+        for req, prm in zip(reqs, prompts):
+            res = req.result(timeout=600.0)
+            log_main(
+                f"serving smoke: prompt[{len(prm)} tok] bucket={res.bucket} "
+                f"-> {res.tokens.tolist() if res.tokens.size else '[]'} "
+                f"(prefill {res.prefill_s * 1e3:.1f}ms, decode "
+                f"{res.decode_s * 1e3:.1f}ms)")
+        stop.set()
+        worker.join(timeout=60.0)
+        # drain is idempotent here (queue already empty) — it exists so a
+        # SIGTERM mid-smoke still completes accepted work before exit
+        drain(engine, queue, log=log_main)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    log_main(f"serving smoke: ok ({engine.compiles} compiles)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

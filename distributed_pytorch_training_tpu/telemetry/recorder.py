@@ -51,6 +51,12 @@ SCHEMA_VERSION = 1
 SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync", "eval",
               "save_blocked", "restore")
 
+# The serving phases (serving/): how long a request queued, the prefill
+# and decode dispatch walls, and the shutdown drain. `telemetry summary`
+# buckets these exactly like the training phases — a serving stream's
+# latency story decomposes instead of lumping into "unaccounted".
+SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain")
+
 
 class Recorder:
     """Append-only JSONL + bounded ring buffer of typed events.

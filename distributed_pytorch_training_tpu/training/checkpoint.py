@@ -153,6 +153,10 @@ class CheckpointManager:
         self._pre_finalize_hook = pre_finalize_hook
         self._async = bool(async_save)
         self.last_skipped: List[int] = []
+        # label the most recent restore_latest actually restored (None
+        # before any restore) — serving reads it to provenance the weights
+        # it serves (which label, which manifest digest)
+        self.last_restored: Optional[int] = None
         # labels already proven torn (label -> problem): a torn checkpoint
         # stays torn, so later restores must not re-hash its files to
         # rediscover it. Cleared per label on re-save.
@@ -395,10 +399,29 @@ class CheckpointManager:
                      "nothing to restore")
         return None
 
+    def manifest(self, label: int) -> Optional[dict]:
+        """The integrity manifest of one checkpoint (``tree_digest``,
+        per-file sizes/sha256), or None for a legacy (pre-manifest)
+        checkpoint / unreadable manifest. The serving engine embeds the
+        ``tree_digest`` in its provenance record: a served model names the
+        exact bytes it serves."""
+        self._join_writer(reraise=False)
+        path = self._manifest_path(label)
+        if not path.exists():
+            return None
+        try:
+            return json.loads(path.read_text())
+        except Exception:
+            return None
+
     def _restore(self, label: int,
                  template: TrainState) -> Tuple[TrainState, int, int]:
         with telemetry.span("restore", label=label):
-            return self._restore_inner(label, template)
+            out = self._restore_inner(label, template)
+        # only a restore that SUCCEEDED may claim the label (a template
+        # mismatch raises above — provenance must not name it)
+        self.last_restored = label
+        return out
 
     def _restore_inner(self, label: int,
                        template: TrainState) -> Tuple[TrainState, int, int]:

@@ -11,9 +11,9 @@ from distributed_pytorch_training_tpu.analysis.__main__ import main
 def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     """THE acceptance test: every AST rule over the repo plus every HLO
     contract in the matrix (dp / zero1 / grad_sync x wires / accum /
-    explicit FSDP), lowered on the 8-device CPU mesh — clean, and every
-    contract really evaluated (a matrix of skips would be vacuously
-    green)."""
+    explicit FSDP / the serving decode step), lowered on the 8-device CPU
+    mesh — clean, and every contract really evaluated (a matrix of skips
+    would be vacuously green)."""
     assert main(["check", "--json"]) == 0
     report = json.loads(capsys.readouterr().out)
     assert report["ok"] is True and report["findings"] == []
@@ -23,12 +23,16 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
                              "gsync_bf16_accum", "gsync_int8_mh",
                              "gsync_int8_mh_accum", "gsync_int8_mh_fused",
-                             "fsdp", "fsdp_accum", "fsdp_int8_mh"}
+                             "fsdp", "fsdp_accum", "fsdp_int8_mh",
+                             "serving_decode"}
     assert all(s == "pass" for s in statuses.values()), statuses
-    # both engines actually ran, incl. the fsdp rules (ISSUE 7)
+    # both engines actually ran, incl. the fsdp rules (ISSUE 7) and the
+    # serving decode-step rules (ISSUE 10)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
     assert "fsdp-layer-gather-bound" in kinds
+    assert "decode-cache-donated" in kinds
+    assert "no-host-sync-in-decode" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

@@ -369,3 +369,72 @@ class TestRealDataPipelines:
         val = get_token_dataset("gpt2", seq_len=32,
                                 data_dir=str(tmp_path / "data"), train=False)
         assert not val.synthetic and len(val) >= 1
+
+
+class TestSequenceBuckets:
+    """data/pack.py's ragged-sequence packers — the serving engine's shape
+    contract (ISSUE 10 satellite): bucket choice, static packing, and the
+    unpack round-trip that drops every pad position."""
+
+    def test_bucket_for_picks_smallest_fitting_rung(self):
+        from distributed_pytorch_training_tpu.data.pack import bucket_for
+
+        assert bucket_for(1, (8, 16, 32)) == 8
+        assert bucket_for(8, (8, 16, 32)) == 8
+        assert bucket_for(9, (32, 8, 16)) == 16  # unsorted ladder is fine
+        assert bucket_for(32, (8, 16, 32)) == 32
+
+    def test_bucket_for_rejects_oversize_and_empty(self):
+        from distributed_pytorch_training_tpu.data.pack import bucket_for
+
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            bucket_for(33, (8, 16, 32))
+        with pytest.raises(ValueError, match=">= 1"):
+            bucket_for(0, (8,))
+
+    def test_pack_token_rows_shapes_and_filler(self):
+        from distributed_pytorch_training_tpu.data.pack import (
+            pack_token_rows,
+        )
+
+        seqs = [np.arange(3, dtype=np.int32), np.arange(7, dtype=np.int32)]
+        ids, lengths, weight = pack_token_rows(seqs, bucket=8, rows=4,
+                                               pad_id=0)
+        assert ids.shape == (4, 8) and ids.dtype == np.int32
+        np.testing.assert_array_equal(lengths, [3, 7, 0, 0])
+        np.testing.assert_array_equal(weight, [1.0, 1.0, 0.0, 0.0])
+        np.testing.assert_array_equal(ids[0, :3], seqs[0])
+        assert (ids[0, 3:] == 0).all() and (ids[2:] == 0).all()
+
+    def test_pack_token_rows_rejects_misfits(self):
+        from distributed_pytorch_training_tpu.data.pack import (
+            pack_token_rows,
+        )
+
+        with pytest.raises(ValueError, match="do not fit"):
+            pack_token_rows([np.ones(2, np.int32)] * 3, bucket=8, rows=2)
+        with pytest.raises(ValueError, match="exceeds bucket"):
+            pack_token_rows([np.ones(9, np.int32)], bucket=8, rows=2)
+        with pytest.raises(ValueError, match="not 1-D"):
+            pack_token_rows([np.ones((2, 2), np.int32)], bucket=8, rows=2)
+
+    def test_unpack_round_trips_per_request_outputs(self):
+        """Pack -> per-position compute -> unpack recovers each request's
+        own rows exactly, with every pad position (tail pad AND filler
+        rows) dropped."""
+        from distributed_pytorch_training_tpu.data.pack import (
+            pack_token_rows, unpack_token_rows,
+        )
+
+        rng = np.random.RandomState(0)
+        seqs = [rng.randint(0, 99, n).astype(np.int32) for n in (5, 8, 1)]
+        ids, lengths, _ = pack_token_rows(seqs, bucket=8, rows=4)
+        # a per-position "output": position value + 1000*row, so any
+        # cross-row or cross-position mixup is visible
+        outputs = (ids.astype(np.float64)
+                   + 1000.0 * np.arange(4)[:, None])
+        out = unpack_token_rows(outputs, lengths, n_real=len(seqs))
+        assert len(out) == 3
+        for i, s in enumerate(seqs):
+            assert out[i].shape == (len(s),)
+            np.testing.assert_array_equal(out[i], s + 1000.0 * i)
