@@ -137,7 +137,10 @@ class Contract:
     (`hlo_rules._tiny_lm_setup`); "serving" lowers the inference engine's
     KV-cache decode step (`hlo_rules.evaluate_serving_contract`) — the
     decode-step contract of serving/ (ISSUE 10), run by the same tier-1
-    ``analysis check`` gate.
+    ``analysis check`` gate; "elastic" lowers the SAME train step twice at
+    the halved world — once from a clean state, once from a state
+    resharded down by resilience.elastic — and pins the censuses equal
+    (`hlo_rules.evaluate_elastic_contract`, ISSUE 11).
     """
 
     name: str
@@ -229,6 +232,19 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "in place (serving/engine.py lower_decode)",
              config=dict(serving_decode=True, donate_state=True),
              kind="serving"),
+    # The elastic-reshard contract (ISSUE 11): a state resharded N -> M by
+    # resilience.elastic must lower to EXACTLY the HLO census a clean-at-M
+    # state lowers to — a reshard that lands a leaf replicated (or in any
+    # off-canonical layout) would smuggle extra collectives into every
+    # post-resize step while the run claims a pure re-slice. Evaluated on
+    # the zero1 layout (flat-padded moments — the shapes that actually
+    # change across worlds); min_shards=4 so the halved world still
+    # engages the sharded update.
+    Contract("elastic_reshard",
+             "a reshardedN->M train step's collective census matches the "
+             "clean-at-M census (no reshard-smuggled collectives)",
+             config=dict(elastic_reshard=True, zero1=True),
+             min_shards=4, kind="elastic"),
 )
 
 

@@ -669,6 +669,45 @@ def check_decode_cache_donated(a: StepArtifacts) -> List[Finding]:
     return []
 
 
+@rule("elastic-reshard-census", "hlo",
+      "a resharded N->M state's train step carries exactly the clean-at-M "
+      "collective census",
+      "the elastic reshard promises a pure re-slice: same avals, same "
+      "shardings, same compiled step. A leaf landed replicated (or in any "
+      "off-canonical layout) makes XLA insert extra data movement into "
+      "EVERY post-resize step while the resize claims zero overhead — "
+      "this pins the resharded lowering to the clean-at-M census, op by "
+      "op and shape by shape (resilience/elastic.py; ISSUE 11).")
+def check_elastic_reshard_census(a: StepArtifacts) -> List[Finding]:
+    if not a.config.get("elastic_reshard"):
+        return []
+    expected = a.config.get("elastic_expected_census")
+    if expected is None:
+        return [Finding(
+            "elastic-reshard-census",
+            "elastic_reshard config evaluated without a clean-at-M "
+            "expected census — the evaluator must lower the clean state "
+            "and snapshot its collective_census", a.name)]
+    got = collective_census(a.optimized_text)
+
+    def keyed(rows):
+        return {(r["op"], r["result_shape"]): r["count"] for r in rows}
+
+    got_k, want_k = keyed(got), keyed(expected)
+    if got_k != want_k:
+        extra = {k: v for k, v in got_k.items()
+                 if v != want_k.get(k, 0)}
+        missing = {k: v for k, v in want_k.items()
+                   if v != got_k.get(k, 0)}
+        return [Finding(
+            "elastic-reshard-census",
+            "resharded step's collective census differs from the "
+            f"clean-at-M census — resharded-only/changed: {extra}; "
+            f"clean-only/changed: {missing}. The reshard smuggled data "
+            "movement into (or dropped it from) the step", a.name)]
+    return []
+
+
 @rule("no-host-transfer", "hlo",
       "no host transfers inside the compiled step",
       "a host callback or infeed/outfeed in the step serializes the device "
@@ -836,6 +875,59 @@ def evaluate_serving_contract(contract: Contract,
         min_elements=contract.min_elements)
 
 
+def evaluate_elastic_contract(contract: Contract,
+                              mesh=None) -> StepArtifacts:
+    """The ``kind="elastic"`` evaluator (ISSUE 11): build the tiny
+    contract state at the FULL world N, reshard it down to M = N/2 through
+    the real elastic path (resilience.elastic.reshard_train_state — the
+    same code a Supervisor resize runs), lower the M-world trainer's step
+    on the resharded state, and snapshot its artifacts with the CLEAN
+    clean-at-M census embedded as the expectation
+    (``elastic_expected_census``). jit lowering keys on avals + shardings
+    only, so census equality holds iff the reshard landed every leaf in
+    the canonical M-world layout."""
+    import jax
+
+    from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
+    from ..resilience.elastic import reshard_train_state
+
+    if mesh is None:
+        mesh = build_mesh(MeshSpec(), devices=jax.devices())
+    n = batch_shard_count(mesh)
+    if n < contract.min_shards:
+        raise ValueError(
+            f"contract {contract.name!r} needs >= {contract.min_shards} "
+            f"batch shards (got {n}) — the halved world must still "
+            "engage the sharded update")
+    m = n // 2
+    sub_mesh = build_mesh(MeshSpec(),
+                          devices=list(mesh.devices.flat)[:m])
+    train_cfg = {k: v for k, v in contract.config.items()
+                 if k != "elastic_reshard"}
+    _trainer_n, state_n, _ = _tiny_lm_setup(mesh, train_cfg)
+    trainer_m, state_m, batch_m = _tiny_lm_setup(sub_mesh, train_cfg)
+    resharded = reshard_train_state(state_n, n, m, trainer_m, state_m)
+    key = jax.random.PRNGKey(1)
+    clean_text = trainer_m._train_step.lower(
+        state_m, batch_m, key).compile().as_text()
+    lowered = trainer_m._train_step.lower(resharded, batch_m, key)
+    optimized = lowered.compile().as_text()
+    try:
+        preopt = preopt_hlo_text(lowered)
+    except Exception:  # pragma: no cover - backend without HLO dialect
+        preopt = None
+    return StepArtifacts(
+        name=contract.name,
+        optimized_text=optimized,
+        preopt_text=preopt,
+        config={**contract.config,
+                "elastic_expected_census": collective_census(clean_text)},
+        n_shards=m,
+        min_elements=contract.min_elements,
+        backend=jax.default_backend(),
+    )
+
+
 def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     """Lower + compile one contract's config on `mesh` (default: a pure-DP
     mesh over all local devices) and snapshot the artifacts the rules read.
@@ -845,7 +937,8 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     evaluating the contract would vacuously pass; the caller decides
     whether that is a skip or an error). ``kind="serving"`` contracts
     route to `evaluate_serving_contract` (the inference engine's decode
-    step instead of a Trainer step).
+    step instead of a Trainer step); ``kind="elastic"`` to
+    `evaluate_elastic_contract` (the resharded-vs-clean census pin).
     """
     import jax
 
@@ -854,6 +947,8 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
 
     if contract.kind == "serving":
         return evaluate_serving_contract(contract, mesh=mesh)
+    if contract.kind == "elastic":
+        return evaluate_elastic_contract(contract, mesh=mesh)
     if mesh is None:
         mesh = build_mesh(MeshSpec(), devices=jax.devices())
     n_shards = batch_shard_count(mesh)

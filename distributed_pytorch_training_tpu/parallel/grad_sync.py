@@ -795,6 +795,82 @@ def ef_state_fsdp(params: Any, mesh, n_shards: int):
     return {"ef": _born_sharded_zeros(structs, mesh)}
 
 
+def fold_ef_rows(rows, new_n: int):
+    """Re-chunk per-replica error-feedback ROWS from old-N to new-M
+    replicas: new row m is the sum of old rows ``{m, m + M, m + 2M, ...}``
+    (growing, M > N: the extra rows are zero).
+
+    The invariant this preserves EXACTLY (element-wise, in order-fixed fp
+    summation) is the column-wise TOTAL — the telescoping sum of carried
+    quantization error across replicas, which is what re-enters the next
+    reduction (each replica adds its row to its contribution before
+    quantizing, and the collective sums all rows). The per-row DISTRIBUTION
+    changes, so post-resize quantization scales differ from either
+    fixed-world run — a bounded, deterministic re-association the elastic
+    exactness model documents (PARITY.md). Host-side numpy, restore time.
+    """
+    import numpy as np
+
+    rows = np.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(f"fold_ef_rows expects (n, R) rows, got shape "
+                         f"{rows.shape}")
+    old_n, r = rows.shape
+    out = np.zeros((new_n, r), rows.dtype)
+    for i in range(old_n):
+        out[i % new_n] += rows[i]
+    return out
+
+
+def reshard_multihop_ef_row(row, plan: BucketPlan, old_n: int,
+                            new_n: int):
+    """Re-chunk ONE multihop hop-1 residual row from the old-N
+    `padded_bucket_bounds` layout to the new-M one: each bucket's padded
+    region is truncated-or-zero-extended independently (the pad tail of
+    every bucket is exactly zero — the carried value at a pad slot is
+    always 0, so the hop-1 residual never accumulates there)."""
+    import numpy as np
+
+    from .sharding import reshard_flat_padded
+
+    old_b = padded_bucket_bounds(plan, old_n)
+    new_b = padded_bucket_bounds(plan, new_n)
+    parts = [
+        reshard_flat_padded(row[a:b], nb - na, name=f"bucket {k}")
+        for k, (a, b, na, nb) in enumerate(
+            zip(old_b, old_b[1:], new_b, new_b[1:]))]
+    return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def reshard_fsdp_ef_row(row, old_group: LayerGroup, new_group: LayerGroup,
+                        old_n: int, new_n: int):
+    """Re-chunk ONE explicit-FSDP group residual row from the old-N
+    destination-major stacking to the new-M one, leaf by leaf (never
+    materializing more than this one layer group): column block i of the
+    (n, row_size) view is leaf i's flat-padded vector reshaped (n, chunk),
+    so per leaf the re-chunk is exactly `reshard_flat_padded` on the
+    unstacked flat vector, restacked at the new chunking."""
+    import numpy as np
+
+    from .sharding import reshard_flat_padded
+
+    row = np.asarray(row)
+    mat = row.reshape(old_n, old_group.row_size)
+    parts = []
+    off = 0
+    for (slot, c_old), c_new in zip(
+            zip(old_group.leaf_slots, old_group.chunk_sizes),
+            new_group.chunk_sizes):
+        leaf_flat = np.ascontiguousarray(
+            mat[:, off:off + c_old]).reshape(-1)
+        leaf_new = reshard_flat_padded(leaf_flat, new_n * c_new,
+                                       name=f"{old_group.name}[{slot}]")
+        parts.append(leaf_new.reshape(new_n, c_new))
+        off += c_old
+    out = np.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+    return out.reshape(-1)
+
+
 def ef_state_zero1(params: Any, mesh, n_shards: int):
     """Per-replica residuals for the zero1 int8 scatter: one
     (n_shards, flat_padded_size) fp32 array PER LEAF (the scatter is

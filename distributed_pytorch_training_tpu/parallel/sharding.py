@@ -218,6 +218,72 @@ def fsdp_flat_params(params: Any, mesh: Mesh, n_shards: int) -> Any:
     return make(params)
 
 
+def reshard_flat_padded(x, new_padded_len: int, name: str = "") -> "np.ndarray":
+    """Re-slice one flat-padded leaf from old-N chunking to new-M chunking.
+
+    A valid flat-padded vector holds its true content in ``[0, true_size)``
+    and zeros beyond (``flatten_pad`` pads with zeros; gradients/updates on
+    pad elements are zero through any elementwise optimizer chain, and the
+    int8 codecs' residuals stay zero there too — the carried value at a pad
+    slot is always 0). Since ``true_size <= flat_padded_size(true_size, M)``
+    for ANY shard count M, re-chunking reduces to truncate-or-zero-extend
+    to the new padded length — no true size needed. Host-side numpy (this
+    runs at restore time, one leaf at a time — never on the step path).
+
+    Shrinking asserts the dropped tail really is zero: a nonzero tail means
+    the input was NOT a flat-padded layout (or carried real content into
+    the pad region) and silently dropping it would corrupt the trajectory.
+    """
+    x = np.asarray(x)
+    if x.ndim != 1:
+        raise ValueError(
+            f"reshard_flat_padded expects a 1-D flat-padded vector, got "
+            f"shape {x.shape}" + (f" for {name}" if name else ""))
+    old_len = x.shape[0]
+    if new_padded_len < old_len:
+        tail = x[new_padded_len:]
+        if np.any(tail):
+            raise ValueError(
+                f"re-chunking {old_len} -> {new_padded_len} elements would "
+                f"drop {int(np.count_nonzero(tail))} NONZERO tail "
+                "element(s) — the input is not a zero-padded flat layout"
+                + (f" ({name})" if name else ""))
+        return np.array(x[:new_padded_len])
+    if new_padded_len > old_len:
+        return np.pad(x, (0, new_padded_len - old_len))
+    return np.array(x)
+
+
+def reshard_flat_leaf(value, new_shape: Tuple[int, ...],
+                      name: str = "") -> "np.ndarray":
+    """The ONE per-leaf reshard dispatch (both the whole-tree helper below
+    and the elastic restore path route through it, so the invariant cannot
+    fork): same shape -> passthrough, 1-D length change -> flat-padded
+    re-chunk, anything else -> loud structure error naming the leaf."""
+    v = np.asarray(value)
+    t = tuple(new_shape)
+    if v.shape == t:
+        return v
+    if v.ndim == 1 and len(t) == 1:
+        return reshard_flat_padded(v, t[0], name=name)
+    raise ValueError(
+        f"cannot reshard leaf {name!r} from shape {v.shape} to {t} — "
+        "only flat-padded 1-D leaves change shape across world sizes")
+
+
+def reshard_flat_tree(old_tree: Any, template_tree: Any) -> Any:
+    """Re-slice every flat-padded leaf of ``old_tree`` into the shapes of
+    ``template_tree`` (the new-world layout) via `reshard_flat_leaf`.
+    Values are host numpy — the caller places them on the new mesh.
+    (The elastic restore uses the leaf-at-a-time placing variant,
+    `resilience.elastic._reshard_and_place`, to keep host memory bounded
+    by one leaf; both share `reshard_flat_leaf`.)"""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, old, tmpl: reshard_flat_leaf(
+            old, np.shape(tmpl), name=_path_str(path)),
+        old_tree, template_tree)
+
+
 def batch_spec(ndim: int = 1) -> P:
     """Leading dim sharded over the batch axes (data, fsdp); rest replicated.
 

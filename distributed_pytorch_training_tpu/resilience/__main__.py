@@ -14,6 +14,17 @@ fault goes through the real ``PreemptionGuard``). ``--verify-parity``
 final params are BITWISE equal — recovery that changed the trajectory is a
 failure, not a recovery.
 
+``--elastic`` (ISSUE 11) arms the Supervisor's mesh re-planner: the
+default schedule kills a replica mid-epoch (``replica_death@step=3``),
+the run re-plans to the largest feasible world <= survivors, reshards the
+checkpoint (flat-padded re-slice + EF row fold — resilience/elastic.py),
+and CONTINUES at the shrunken size. The parity control then becomes the
+post-resize one: restore the SAME resize-point checkpoint independently,
+reshard it the same way, run the remaining steps clean at the new world —
+the post-resize segment must be BITWISE equal. ``--layout
+{replicated,zero1,fsdp}`` and ``--wire-dtype`` pick the state layout the
+resize must re-slice (int8 wires include the EF residuals).
+
 Exit codes: 0 recovered (and parity held), 1 not.
 """
 
@@ -37,6 +48,7 @@ FLIGHT_SIGNATURES = {
     "crash_during_save": "crash_during_save",
     "sigterm": "sigterm",
     "torn_ckpt": "torn_checkpoint",
+    "replica_death": "replica_death",
 }
 
 
@@ -71,9 +83,15 @@ def check_flights(flight_dir, fired: List[str],
 
 
 def _build_rig(mesh, seed: int, dataset_size: int, per_device_batch: int,
-               fault_hook=None):
+               fault_hook=None, layout: str = "replicated",
+               wire_dtype: str = "fp32"):
     """(trainer, state_factory, loader) — the tiny-ResNet chaos workload
-    (fp32, augmentation off: bitwise parity is the acceptance bar)."""
+    (fp32 master, augmentation off: bitwise parity is the acceptance bar).
+    ``layout`` picks the state layout a chaos/elastic run exercises:
+    "replicated" (the DDP layout), "zero1" (flat-sharded moments) or
+    "fsdp" (flat-sharded params + moments); an int8 ``wire_dtype`` adds
+    the error-feedback residuals to the state (the elastic reshard must
+    carry all of them)."""
     import jax
     import numpy as np
 
@@ -92,7 +110,13 @@ def _build_rig(mesh, seed: int, dataset_size: int, per_device_batch: int,
                       name="chaos-synthetic", synthetic=True)
     task = ImageClassificationTask(mean=(0.5, 0.5, 0.5),
                                    std=(0.25, 0.25, 0.25), augment=False)
-    trainer = Trainer(task, mesh, TrainConfig(seed=seed, print_freq=10_000))
+    if layout not in ("replicated", "zero1", "fsdp"):
+        raise ValueError(f"unknown chaos layout {layout!r} "
+                         "(replicated | zero1 | fsdp)")
+    cfg = TrainConfig(seed=seed, print_freq=10_000, wire_dtype=wire_dtype,
+                      zero1=layout == "zero1",
+                      fsdp_explicit=layout == "fsdp")
+    trainer = Trainer(task, mesh, cfg)
     # num_filters=8: a ~170k-param ResNet-18 — BatchNorm state and the full
     # recovery chain exercised, checkpoints small enough that the manifest
     # hashing and the several restores stay in tier-1 time
@@ -109,16 +133,68 @@ def _build_rig(mesh, seed: int, dataset_size: int, per_device_batch: int,
     return trainer, state_factory, loader
 
 
+def _elastic_control(args, ckpt_dir: str, report, rig_for):
+    """The post-resize control trajectory: restore the LAST resize's
+    checkpoint against its old-world template, reshard to the final world
+    through the same helpers the supervisor used, and run the remaining
+    steps clean (no faults fire — the injector's schedule is spent — and
+    no supervisor segmentation). Returns the control state, or None when
+    the resize restarted from scratch (nothing to pin a segment against).
+    """
+    from ..training.checkpoint import CheckpointManager
+    from .elastic import reshard_train_state
+
+    last = report.resizes[-1]
+    label, to_w = last["label"], last["to_world"]
+    if label is None:
+        return None
+    trainer_to, sf_to, loader_to = rig_for(to_w)
+    ckpt = CheckpointManager(ckpt_dir, max_to_keep=64)
+    try:
+        # the checkpoint's OWN recorded world, not the resize record's
+        # from_world: a second death before any post-resize save restores
+        # a label still laid out for an earlier world
+        saved_w = ckpt.checkpoint_world_size(label) or last["from_world"]
+        _t, sf_from, _l = rig_for(saved_w)
+        restored = ckpt.restore_latest(sf_from(), among={label})
+    finally:
+        ckpt.close()
+    from_w = saved_w
+    if restored is None:
+        return None
+    control, epoch_r, step_r = restored
+    control = reshard_train_state(control, from_w, to_w, trainer_to,
+                                  sf_to())
+    spe = len(loader_to)
+    for epoch in range(epoch_r, args.epochs):
+        start = step_r if epoch == epoch_r else 0
+        control, *_ = trainer_to.train_epoch(
+            control, loader_to.epoch(epoch, start_step=start), epoch, spe,
+            start_step=start)
+    return control
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="resilience", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("command", choices=["chaos"],
                    help="'chaos' runs the scripted fault schedule")
-    p.add_argument("--chaos",
-                   default="crash@step=3,torn_ckpt@save=2,"
-                           "crash_during_save@save=2,sigterm@step=6",
-                   help="fault plan (resilience/faults.py spec)")
+    p.add_argument("--chaos", default=None,
+                   help="fault plan (resilience/faults.py spec; default: "
+                        "the full fixed-world schedule, or "
+                        "replica_death@step=3 with --elastic)")
+    p.add_argument("--elastic", action="store_true",
+                   help="arm the Supervisor's mesh re-planner: a "
+                        "replica_death fault restarts the run resharded "
+                        "to the surviving replica count, and the parity "
+                        "control verifies the post-resize segment bitwise")
+    p.add_argument("--layout", default="replicated",
+                   choices=["replicated", "zero1", "fsdp"],
+                   help="state layout the run (and any reshard) exercises")
+    p.add_argument("--wire-dtype", default="fp32",
+                   help="gradient wire dtype (int8 wires add EF residuals "
+                        "to the resharded state)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--per-device-batch", type=int, default=2)
     p.add_argument("--dataset-size", type=int, default=64)
@@ -132,6 +208,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable one-line report on stdout")
     args = p.parse_args(argv)
+    if args.chaos is None:
+        args.chaos = ("replica_death@step=3" if args.elastic else
+                      "crash@step=3,torn_ckpt@save=2,"
+                      "crash_during_save@save=2,sigterm@step=6")
 
     # The zero1/grad_sync trick reused: chaos runs on the 8-device virtual
     # CPU mesh unless a real accelerator is already up.
@@ -149,9 +229,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     mesh = build_mesh(MeshSpec(), devices=jax.devices())
     injector = FaultInjector(FaultPlan.parse(args.chaos))
-    trainer, state_factory, loader = _build_rig(
-        mesh, args.seed, args.dataset_size, args.per_device_batch,
-        fault_hook=injector.on_loader_batch)
+    world0 = len(jax.devices())
+    global_batch = args.per_device_batch * world0
+    # one rig per world this run has trained at — the replan builds them
+    # lazily over device SUBSETS (the in-process stand-in for a relaunch
+    # on the surviving fleet), and the parity control reuses them
+    rigs = {}
+
+    def rig_for(world: int):
+        # every rig carries the fault hook — the parity control stays
+        # clean anyway because a completed run's schedule is spent (the
+        # injector's takes are empty membership checks by then)
+        if world not in rigs:
+            sub = (mesh if world == world0 else
+                   build_mesh(MeshSpec(), devices=jax.devices()[:world]))
+            if global_batch % world:
+                raise ValueError(
+                    f"global batch {global_batch} does not divide over "
+                    f"{world} replicas")
+            rigs[world] = _build_rig(
+                sub, args.seed, args.dataset_size, global_batch // world,
+                fault_hook=injector.on_loader_batch,
+                layout=args.layout, wire_dtype=args.wire_dtype)
+        return rigs[world]
+
+    trainer, state_factory, loader = rig_for(world0)
     ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="dpt-chaos-")
     # Telemetry + flight recorder (telemetry/): the supervisor flushes a
     # flight_<ts>.json per failure/drain into this stream's directory —
@@ -161,11 +263,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     telemetry.configure(str(Path(ckpt_dir) / "telemetry_rank0.jsonl"),
                         meta={"entry": "resilience chaos",
                               "chaos": args.chaos})
+    # Warm-restart compilation cache (DPT_COMPILE_CACHE tri-state): off by
+    # default on the CPU harness ("auto" refuses XLA:CPU — unsafe reloads),
+    # measurable on accelerators where an elastic resize otherwise pays a
+    # full recompile of the resized step.
+    from ..runtime import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(Path(ckpt_dir) / ".jax_cache")
     # async saves ON (the production default): the schedule's
     # crash_during_save fault dies on the background writer and must
-    # surface at the next save/wait barrier inside the recovery scope
+    # surface at the next save/wait barrier inside the recovery scope.
+    # Elastic runs keep every label (max_to_keep): the parity control must
+    # re-restore the exact resize-point checkpoint after the run.
     ckpt = CheckpointManager(ckpt_dir, post_save_hook=injector.on_save,
-                             pre_finalize_hook=injector.on_save_finalize)
+                             pre_finalize_hook=injector.on_save_finalize,
+                             max_to_keep=(64 if args.elastic else 3))
     guard = PreemptionGuard.install()
     # flights already in the dir belong to a PREVIOUS run (user-supplied
     # --ckpt-dir reuse) — excluded from this run's verification
@@ -173,10 +285,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     # fast, deterministic backoff: chaos is a harness, not a prod outage
     retry = RetryPolicy(max_restarts=args.max_restarts, backoff_base_s=0.01,
                         backoff_max_s=0.05, seed=args.seed)
+
+    replan_cb = None
+    if args.elastic:
+        from .elastic import ElasticPlan, plan_elastic_world
+
+        def replan_cb(survivors: int) -> "ElasticPlan":
+            world = plan_elastic_world(survivors, global_batch)
+            t, sf, ld = rig_for(world)
+            return ElasticPlan(trainer=t, loader=ld, state_factory=sf,
+                               world=world)
+
     sup = Supervisor(trainer, ckpt, state_factory, loader, retry=retry,
                      guard=guard, injector=injector,
                      checkpoint_every_steps=args.checkpoint_every_steps,
-                     resume_preempted=True)
+                     resume_preempted=True, replan_cb=replan_cb)
     error = None
     try:
         state, report = sup.run(args.epochs)
@@ -192,16 +315,28 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     parity = None
     if state is not None and not args.no_verify_parity:
-        # control: same seed, same trainer (same compiled step), NO faults,
-        # no supervisor segmentation — the uninterrupted trajectory.
-        _, _, control_loader = _build_rig(
-            mesh, args.seed, args.dataset_size, args.per_device_batch)
-        control = state_factory()
-        spe = len(control_loader)
-        for epoch in range(args.epochs):
-            control, *_ = trainer.train_epoch(
-                control, control_loader.epoch(epoch), epoch, spe)
-        parity = all(
+        if report.resizes:
+            # ELASTIC parity: the post-resize segment vs an independent
+            # clean continuation at the shrunken world — restore the SAME
+            # resize-point checkpoint with the old-world template, reshard
+            # it through the same helpers, and train the remaining steps
+            # with no supervisor segmentation. Bitwise equality proves the
+            # reshard is a pure re-slice and the resumed sampler/RNG
+            # schedule is the fixed-world-at-M one (PARITY.md).
+            control = _elastic_control(args, ckpt_dir, report, rig_for)
+        else:
+            # control: same seed, same trainer (same compiled step), NO
+            # faults, no supervisor segmentation — the uninterrupted
+            # trajectory.
+            _, _, control_loader = _build_rig(
+                mesh, args.seed, args.dataset_size, args.per_device_batch,
+                layout=args.layout, wire_dtype=args.wire_dtype)
+            control = state_factory()
+            spe = len(control_loader)
+            for epoch in range(args.epochs):
+                control, *_ = trainer.train_epoch(
+                    control, control_loader.epoch(epoch), epoch, spe)
+        parity = control is not None and all(
             bool(np.array_equal(np.asarray(jax.device_get(a)),
                                 np.asarray(jax.device_get(b))))
             for a, b in zip(jax.tree_util.tree_leaves(state.params),
@@ -209,6 +344,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     stats = {"metric": "chaos_recovery", "chaos": args.chaos,
              "epochs": args.epochs, "ckpt_dir": ckpt_dir,
+             "elastic": args.elastic, "layout": args.layout,
+             "wire_dtype": args.wire_dtype,
              "parity_bitwise": parity, "error": error,
              # the async-save instrument: loop-blocked ms vs snapshot ms
              "save_blocked_ms": round(ckpt.save_blocked_ms, 1),
@@ -216,10 +353,12 @@ def main(argv: Optional[List[str]] = None) -> int:
              **flight_stats,
              **report.as_dict()}
     # flights_ok is part of RECOVERED: a fault that left no postmortem
-    # artifact would make the next real incident undiagnosable
+    # artifact would make the next real incident undiagnosable; an elastic
+    # run that never resized (the schedule missed) proved nothing
     ok = (report.completed and report.fence_violations == 0
           and parity is not False and error is None
-          and flight_stats["flights_ok"])
+          and flight_stats["flights_ok"]
+          and (not args.elastic or bool(report.resizes)))
     if args.as_json:
         print(json.dumps(stats, sort_keys=True))
     else:
@@ -228,6 +367,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "fence_violations", "final_step", "parity_bitwise"):
             print(f"{k}: {stats[k]}")
         print(f"faults fired: {stats['faults_fired']}")
+        for r in stats.get("resizes", []):
+            print(f"elastic resize: {r['from_world']} -> {r['to_world']} "
+                  f"replicas (survivors={r['survivors']}, restored label "
+                  f"{r['label']}, resumed epoch {r['epoch']} "
+                  f"step {r['step']})")
         print(f"flight artifacts: {len(stats['flights'])} "
               f"(ok={stats['flights_ok']}"
               + (f", missing={stats['flights_missing']}"

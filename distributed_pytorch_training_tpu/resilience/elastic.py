@@ -1,0 +1,223 @@
+"""Elastic data parallelism: re-plan the mesh on restart, reshard the
+restored state from N to M replicas (ISSUE 11 tentpole).
+
+The reference's whole premise is a static ``world_size`` (torch.distributed
+init, train_ddp.py:53-68): lose one replica in a preemptible fleet and the
+run stalls until the exact same world comes back. Here the flat-padded 1/N
+layouts the repo already ships (zero1's weight-update sharding, explicit
+FSDP's at-rest params+moments, the int8 wires' EF residuals) make a resize
+a RE-SLICE, not a gather:
+
+* **The plan** (:func:`plan_elastic_world`): the largest DP degree ``M <=
+  survivors`` that divides the (fixed) global batch. The GLOBAL batch is
+  held constant across resizes — per-device batch grows — so the sampler's
+  permutation, the steps-per-epoch arithmetic, the step fence, and the
+  per-step RNG fold (``state.step``) are all UNCHANGED by a resize; only
+  the layout of the same trajectory changes.
+
+* **The reshard** (:func:`reshard_train_state`): leaf-at-a-time host
+  re-chunking from the old-N flat-padded layout into a new-M template's
+  shapes and shardings — replicated leaves pass through, flat-padded
+  leaves truncate-or-zero-extend (`parallel.sharding.reshard_flat_padded`;
+  the pad region of a valid flat-padded leaf is exactly zero, so the
+  re-slice is EXACT), and the per-replica EF residual rows fold N -> M
+  preserving the telescoping column-wise total
+  (`parallel.grad_sync.fold_ef_rows`). Never gathers more than one leaf /
+  layer group at a time: peak host memory is one leaf beyond the state
+  itself.
+
+The Supervisor drives this through ``replan_cb`` (supervisor.py); the
+``resilience chaos --elastic`` harness proves the post-resize segment
+bitwise-equal to a clean same-seed continuation at the shrunken world
+(PARITY.md "Exactness model: elastic reshard").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """What a ``replan_cb`` hands back to the Supervisor after a replica
+    death: a trainer/loader/state_factory rebuilt on the surviving-device
+    mesh at ``world`` batch shards. The loader MUST keep the old run's
+    GLOBAL batch (the supervisor rejects a steps-per-epoch change — the
+    step fence arithmetic depends on it)."""
+
+    trainer: Any
+    loader: Any
+    state_factory: Callable[[], Any]
+    world: int
+
+
+def plan_elastic_world(survivors: int, global_batch: int) -> int:
+    """The mesh re-plan: largest DP degree ``M <= survivors`` dividing the
+    fixed global batch (M=1 always qualifies — a single survivor still
+    trains). Not simply ``survivors``: 7 survivors of 8 with a global
+    batch of 16 re-plan to 4 — the batch must still split evenly, and a
+    non-divisor world would change the per-shard batch shapes mid-run."""
+    if survivors < 1:
+        raise ValueError(f"cannot re-plan a mesh for {survivors} surviving "
+                         "replica(s)")
+    if global_batch < 1:
+        raise ValueError(f"global batch must be >= 1, got {global_batch}")
+    for m in range(min(survivors, global_batch), 0, -1):
+        if global_batch % m == 0:
+            return m
+    return 1
+
+
+def _place_leaf(value, template_leaf):
+    """One host value -> a device array in the template leaf's layout."""
+    import jax
+
+    return jax.device_put(
+        np.asarray(value).astype(template_leaf.dtype),
+        template_leaf.sharding)
+
+
+def _reshard_and_place(old_tree, template_tree):
+    """`parallel.sharding.reshard_flat_leaf` per leaf plus placement, one
+    leaf at a time (device_get -> re-chunk -> device_put before the next
+    leaf is touched — the bounded-host-memory variant of
+    `reshard_flat_tree`); failures name the offending leaf path."""
+    import jax
+
+    from ..parallel.sharding import _path_str, reshard_flat_leaf
+
+    def one(path, old, tmpl):
+        v = reshard_flat_leaf(jax.device_get(old), tmpl.shape,
+                              name=_path_str(path))
+        return _place_leaf(v, tmpl)
+
+    return jax.tree_util.tree_map_with_path(one, old_tree, template_tree)
+
+
+def _reshard_grad_sync(old_gs, template_gs, trainer, old_n: int,
+                       new_n: int):
+    """Reshard the EF residuals (TrainState.grad_sync) into the new-world
+    layout the trainer expects. Three layouts, matched to the trainer's
+    engaged mode exactly as Trainer.init_state built them:
+
+    * fsdp: ``{"ef": {group: (n, n*row)}}`` — destination-major per-group
+      stacking; rows fold N->M, each row re-chunks leaf-by-leaf
+      (`reshard_fsdp_ef_row`, old/new LayerPlans from the shapes-only
+      fsdp template — one group in memory at a time);
+    * zero1: ``{"ef": per-leaf (n, flat_padded(leaf, n))}`` — rows fold,
+      each row truncate-or-extends to the new per-leaf padding;
+    * bucketed reducer: ``{"ef": (n, R)}`` with R = flat total ("int8") or
+      the padded-per-bucket multihop layout (re-chunked per bucket via
+      `reshard_multihop_ef_row`, same bucket_cap_mb on both sides — the
+      plan-dependence ef_state_bucketed documents).
+    """
+    import jax
+
+    from ..parallel.grad_sync import (
+        build_layer_plan, fold_ef_rows, reshard_fsdp_ef_row,
+    )
+
+    old_leaves = jax.tree_util.tree_leaves(old_gs)
+    tmpl_leaves = jax.tree_util.tree_leaves(template_gs)
+    if not old_leaves and not tmpl_leaves:
+        return template_gs
+    if bool(old_leaves) != bool(tmpl_leaves):
+        raise ValueError(
+            "error-feedback residuals exist on only one side of the "
+            "resize (old vs new trainer wire modes differ) — an elastic "
+            "resize must keep the training config, only the mesh changes")
+
+    if getattr(trainer, "_fsdp", False):
+        tmpl = trainer._fsdp_template
+        old_plan = build_layer_plan(tmpl, old_n)
+        new_plan = build_layer_plan(tmpl, new_n)
+        old_groups = {g.name: g for g in old_plan.groups}
+        new_groups = {g.name: g for g in new_plan.groups}
+        out = {}
+        for name, tmpl_leaf in template_gs["ef"].items():
+            rows = fold_ef_rows(
+                np.asarray(jax.device_get(old_gs["ef"][name])), new_n)
+            new = np.stack([
+                reshard_fsdp_ef_row(r, old_groups[name], new_groups[name],
+                                    old_n, new_n)
+                for r in rows])
+            out[name] = _place_leaf(new, tmpl_leaf)
+        return {"ef": out}
+
+    if getattr(trainer, "_grad_sync", False):
+        # bucketed reducer: one (n, R) array
+        tmpl_leaf = template_gs["ef"]
+        rows = fold_ef_rows(np.asarray(jax.device_get(old_gs["ef"])),
+                            new_n)
+        if rows.shape[1] != tmpl_leaf.shape[1]:
+            # the multihop padded-per-bucket layout is the only bucketed
+            # residual whose length depends on the shard count — it is
+            # handled upstream (reshard_train_state's multihop branch)
+            raise ValueError(
+                "bucketed EF residual length changed across the resize "
+                f"({rows.shape[1]} -> {tmpl_leaf.shape[1]}) but the wire "
+                "is not int8_multihop — the state was built under a "
+                "different bucket plan")
+        return {"ef": _place_leaf(rows, tmpl_leaf)}
+
+    # zero1: per-leaf tree of (n, padded) rows
+    def one(old, tmpl):
+        from ..parallel.sharding import reshard_flat_padded
+
+        rows = fold_ef_rows(np.asarray(jax.device_get(old)), new_n)
+        if rows.shape[1] != tmpl.shape[1]:
+            rows = np.stack([reshard_flat_padded(r, int(tmpl.shape[1]))
+                             for r in rows])
+        return _place_leaf(rows, tmpl)
+
+    return {"ef": jax.tree_util.tree_map(one, old_gs["ef"],
+                                         template_gs["ef"])}
+
+
+def reshard_train_state(state, old_n: int, new_n: int, trainer,
+                        template) -> Any:
+    """Reshard a restored TrainState from the old-N layout into the new-M
+    ``template``'s layout (a fresh ``trainer.init_state(...)`` output on
+    the new mesh — used for SHAPES, dtypes and shardings only; its values
+    are discarded).
+
+    Exactness (PARITY.md): the re-slice is value-exact — replicated leaves
+    and the true region of every flat-padded leaf are copied bit-for-bit,
+    pad regions are zeros on both sides, and the EF residual column totals
+    are preserved. The new mesh placement changes WHERE bytes live, never
+    what they are. One leaf (one layer group for fsdp EF) is gathered to
+    host at a time."""
+    import jax
+
+    new_params = _reshard_and_place(state.params, template.params)
+    new_opt = _reshard_and_place(state.opt_state, template.opt_state)
+    new_stats = _reshard_and_place(state.batch_stats, template.batch_stats)
+    multihop_bucketed = (
+        getattr(trainer, "_grad_sync", False)
+        and trainer.config.wire_dtype == "int8_multihop"
+        and jax.tree_util.tree_leaves(state.grad_sync))
+    if multihop_bucketed:
+        from ..parallel.grad_sync import (
+            build_bucket_plan, fold_ef_rows, reshard_multihop_ef_row,
+        )
+
+        # the multihop residual re-chunks per bucket, against the SAME
+        # bucket plan (same cap, model-shaped params) on both sides —
+        # the bucketed reducer only runs with replicated params
+        plan = build_bucket_plan(template.params,
+                                 trainer.config.bucket_cap_mb)
+        rows = fold_ef_rows(
+            np.asarray(jax.device_get(state.grad_sync["ef"])), new_n)
+        rows = np.stack([reshard_multihop_ef_row(r, plan, old_n, new_n)
+                         for r in rows])
+        new_gs = {"ef": _place_leaf(rows, template.grad_sync["ef"])}
+    else:
+        new_gs = _reshard_grad_sync(state.grad_sync, template.grad_sync,
+                                    trainer, old_n, new_n)
+    return template.replace(
+        step=_place_leaf(jax.device_get(state.step), template.step),
+        params=new_params, opt_state=new_opt, batch_stats=new_stats,
+        grad_sync=new_gs)

@@ -22,6 +22,17 @@ points, so every failure a test provokes is reproducible:
   trusting it as a legacy one.
 * ``loader_stall@step=5:2.5s`` — sleep 2.5s in the data loader before
   producing the batch of (in-epoch) step 5.
+* ``replica_death@step=7`` — raise :class:`ReplicaDeathError` at the
+  step-7 fence: one data-parallel replica is lost (the preemptible-fleet
+  failure). Under a Supervisor armed with ``replan_cb`` this triggers an
+  ELASTIC restart — the mesh re-plans to the surviving replica count and
+  the checkpoint reshards (resilience/elastic.py); without one it is an
+  ordinary restartable crash.
+
+Any spec may carry a repeat count: ``replica_death@step=3x2`` fires TWICE
+(the restart's replay re-crosses the step-3 fence and the second firing
+shrinks the mesh again) — multi-fault elastic schedules without one-shot
+workarounds. One-shot remains the default.
 
 Step indices are the ABSOLUTE global step (``state.step`` before the step
 executes, i.e. steps are 0-indexed from the start of the run) for ``crash``
@@ -60,15 +71,35 @@ FAULT_KINDS = {
     "loader_stall": "step",
     "torn_ckpt": "save",
     "crash_during_save": "save",
+    "replica_death": "step",
 }
 
+# Repeat counts (`kind@trigger=N xK`, e.g. "replica_death@step=3x2"): the
+# fault consumes one firing per matching trigger occurrence until K are
+# spent. The one-shot default (no xK) is unchanged. The canonical use is
+# multi-fault ELASTIC schedules: a replica death at step k restarts the
+# run resharded, the replay re-crosses the step-k fence, and the second
+# firing shrinks the mesh again — no one-shot workaround spec needed.
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@(?P<trigger>[a-z]+)=(?P<at>\d+)"
-    r"(?::(?P<arg>\d+(?:\.\d+)?)s?)?$")
+    r"(?::(?P<arg>\d+(?:\.\d+)?)s?)?(?:\s*x(?P<rep>\d+))?$")
 
 
 class FaultError(RuntimeError):
     """An injected crash — the supervisor's restartable failure class."""
+
+
+class ReplicaDeathError(FaultError):
+    """An injected loss of a data-parallel replica (``replica_death@step=k``
+    — the preemptible-fleet failure a fixed-world restart cannot absorb).
+    Raised at the step fence like ``crash``; a Supervisor armed with a
+    ``replan_cb`` treats it as the elastic-resize trigger: restart at the
+    surviving replica count instead of the dead world. ``survivors`` is
+    filled by the supervisor (the injector has no world-size view)."""
+
+    def __init__(self, message: str, survivors: Optional[int] = None):
+        super().__init__(message)
+        self.survivors = survivors
 
 
 def _stderr_log(msg: str) -> None:
@@ -77,14 +108,20 @@ def _stderr_log(msg: str) -> None:
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
-    kind: str        # crash | sigterm | loader_stall | torn_ckpt
+    kind: str        # crash | sigterm | loader_stall | torn_ckpt | ...
     trigger: str     # "step" or "save"
     at: int          # step index (0-based) or save count (1-based)
     seconds: float = 0.0  # loader_stall duration
+    count: int = 1   # repeat count (the `xK` suffix): firings before spent
 
-    def label(self) -> str:
+    def label(self, remaining: Optional[int] = None) -> str:
+        """Base label of ONE firing (what `fired` records — signatures key
+        on it); with ``remaining`` > 1 the spec-form repeat suffix rides
+        along (what `unfired()` reports)."""
         tail = f":{self.seconds:g}s" if self.kind == "loader_stall" else ""
-        return f"{self.kind}@{self.trigger}={self.at}{tail}"
+        rep = (f"x{remaining}" if remaining is not None and remaining > 1
+               else "")
+        return f"{self.kind}@{self.trigger}={self.at}{tail}{rep}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,8 +161,14 @@ class FaultPlan:
             if kind != "loader_stall" and m.group("arg"):
                 raise ValueError(
                     f"chaos fault {kind!r} takes no :SECs argument ({item!r})")
+            count = int(m.group("rep") or 1)
+            if count < 1:
+                raise ValueError(
+                    f"chaos fault repeat count must be >= 1 ({item!r}; "
+                    "omit the x-suffix for a one-shot fault)")
             faults.append(Fault(kind=kind, trigger=trigger,
-                                at=int(m.group("at")), seconds=seconds))
+                                at=int(m.group("at")), seconds=seconds,
+                                count=count))
         return cls(faults=tuple(faults))
 
     @classmethod
@@ -166,7 +209,10 @@ class FaultInjector:
                  log: Callable[[str], None] = _stderr_log):
         self.plan = plan
         self.log = log
-        self._pending: List[Fault] = list(plan.faults)
+        # [fault, remaining firings] — `remaining` starts at the parsed
+        # repeat count (1 without an xK suffix) and the fault leaves the
+        # pending list only once spent
+        self._pending: List[list] = [[f, f.count] for f in plan.faults]
         self.fired: List[str] = []
         self.saves_seen = 0
         self.finalizes_seen = 0
@@ -178,13 +224,17 @@ class FaultInjector:
 
     def unfired(self) -> List[str]:
         with self._lock:
-            return [f.label() for f in self._pending]
+            return [f.label(remaining=n) for f, n in self._pending]
 
     def _take(self, kind: str, at: int) -> Optional[Fault]:
         with self._lock:
-            for f in self._pending:
+            for entry in self._pending:
+                f, remaining = entry
                 if f.kind == kind and f.at == at:
-                    self._pending.remove(f)
+                    if remaining <= 1:
+                        self._pending.remove(entry)
+                    else:
+                        entry[1] = remaining - 1
                     self.fired.append(f.label())
                     return f
             return None
@@ -194,6 +244,11 @@ class FaultInjector:
         if self._take("sigterm", step) is not None:
             self.log(f"chaos: delivering SIGTERM at step {step}")
             os.kill(os.getpid(), signal.SIGTERM)
+        if self._take("replica_death", step) is not None:
+            self.log(f"chaos: injected replica death at step {step}")
+            raise ReplicaDeathError(
+                f"injected replica_death@step={step} (one data-parallel "
+                "replica lost)")
         if self._take("crash", step) is not None:
             self.log(f"chaos: injected crash at step {step}")
             raise FaultError(f"injected crash@step={step}")

@@ -38,6 +38,7 @@ from typing import Any, Callable, List, Optional, Tuple
 from ..telemetry import flush_flight
 from ..telemetry import recorder as _telemetry
 from ..utils.logging import log_main
+from .faults import ReplicaDeathError
 
 
 class SupervisorError(RuntimeError):
@@ -84,6 +85,11 @@ class RunReport:
     faults_fired: List[str] = dataclasses.field(default_factory=list)
     faults_unfired: List[str] = dataclasses.field(default_factory=list)
     failures: List[str] = dataclasses.field(default_factory=list)
+    # elastic resizes (replan_cb): one record per mesh re-plan —
+    # {from_world, to_world, survivors, label, epoch, step} where `label`
+    # is the checkpoint the resharded restore came from (None = the resize
+    # restarted from scratch) and (epoch, step) is where it resumed
+    resizes: List[dict] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -135,6 +141,7 @@ class Supervisor:
                  trust_existing: bool = True,
                  epoch_end_cb: Optional[Callable[..., None]] = None,
                  deathwatch=None,
+                 replan_cb: Optional[Callable[[int], Any]] = None,
                  sleep: Callable[[float], None] = time.sleep):
         if checkpoint_every_steps is not None and checkpoint_every_steps <= 0:
             raise ValueError("checkpoint_every_steps must be positive "
@@ -151,10 +158,27 @@ class Supervisor:
         self.trust_existing = trust_existing
         self.epoch_end_cb = epoch_end_cb
         self.deathwatch = deathwatch
+        # Elastic mode (ISSUE 11): ``replan_cb(survivors) -> ElasticPlan``
+        # rebuilds the rig on the surviving-device mesh after a
+        # ReplicaDeathError. The resize rides the NORMAL restart path —
+        # one restart counted, one flight flushed, the same deterministic
+        # RetryPolicy backoff — then the restore goes through a per-label
+        # world-size template (restore_latest(template_factory=...)) and
+        # reshards (resilience/elastic.py) when the checkpoint's world
+        # differs from the new one. None = fixed-world behavior, verbatim.
+        self.replan_cb = replan_cb
         self.sleep = sleep
         self._last_step_entered = -1
         self._saved_labels: set = set()
         self._skipped_labels: set = set()
+        # world-size bookkeeping: the manifest records what each save was
+        # laid out for, and _factories keeps one template factory per
+        # world this run has ever trained at (elastic restores build the
+        # OLD world's template, then reshard into the current one)
+        self._world: Optional[int] = getattr(trainer, "batch_shards", None)
+        self._factories = ({self._world: state_factory}
+                           if self._world is not None else {})
+        self._last_restore_label: Optional[int] = None
 
     # -- fence / bookkeeping hooks ----------------------------------------
 
@@ -207,18 +231,85 @@ class Supervisor:
         # The manager itself joins any previous in-flight write first, so
         # an earlier failed save surfaces HERE — inside the recovery try.
         self.ckpt.save(label, state, epoch=save_epoch,
-                       step_in_epoch=in_epoch)
+                       step_in_epoch=in_epoch, world_size=self._world)
         self._saved_labels.add(label)
+
+    def _replan(self, err: ReplicaDeathError, report: RunReport) -> dict:
+        """The elastic resize: hand the surviving replica count to
+        ``replan_cb`` and swap in the rig it builds. Invariants enforced
+        loudly: the new loader must keep the old steps-per-epoch (the
+        GLOBAL batch is fixed across resizes — the step fence, sampler
+        permutation and per-step RNG all depend on it). Returns the
+        resize record (label/epoch/step filled after the restore)."""
+        old_world = self._world
+        survivors = getattr(err, "survivors", None)
+        if survivors is None:
+            survivors = (old_world - 1) if old_world else None
+        if not survivors or survivors < 1:
+            err2 = SupervisorError(
+                f"replica death at world size {old_world} leaves no "
+                "survivors to re-plan onto")
+            err2.report = report  # the chaos CLI reports even a loss
+            raise err2 from err
+        with _telemetry.span("elastic_replan", from_world=old_world,
+                             survivors=survivors):
+            plan = self.replan_cb(survivors)
+        if len(plan.loader) != len(self.loader):
+            err2 = SupervisorError(
+                f"elastic re-plan changed steps-per-epoch "
+                f"({len(self.loader)} -> {len(plan.loader)}) — the replan "
+                "must keep the GLOBAL batch fixed (grow the per-device "
+                "batch), or the step fence and sampler schedule no longer "
+                "describe the same trajectory")
+            err2.report = report
+            raise err2
+        self.trainer = plan.trainer
+        self.loader = plan.loader
+        self.state_factory = plan.state_factory
+        self._world = plan.world
+        self._factories[plan.world] = plan.state_factory
+        _telemetry.counter("elastic_resizes", 1, from_world=old_world,
+                           to_world=plan.world, survivors=survivors)
+        log_main(f"supervisor: elastic resize — mesh re-planned "
+                 f"{old_world} -> {plan.world} replicas "
+                 f"({survivors} survivor(s)); restoring and resharding")
+        return {"from_world": old_world, "to_world": plan.world,
+                "survivors": survivors}
+
+    def _template_for_world(self, world: Optional[int]):
+        """Restore template for a checkpoint recorded at ``world`` batch
+        shards (None = legacy manifest: assume the current world). Only
+        worlds this run has trained at are known — a foreign world in the
+        directory is a loud error, not a guess."""
+        if world is None or world == self._world:
+            return self.state_factory()
+        factory = self._factories.get(world)
+        if factory is None:
+            raise RuntimeError(
+                f"checkpoint was written at world size {world}, but this "
+                f"supervisor only knows worlds {sorted(self._factories)} "
+                "— checkpoints from another run's mesh need a matching "
+                "template (train.py --resume with the original --mesh)")
+        return factory()
 
     def _restore_or_fresh(self, report: RunReport, spe: int
                           ) -> Tuple[Any, int, int]:
         """Latest VALID checkpoint (torn ones are skipped by the manifest
         verification), or a fresh state when none exists. Returns
-        ``(state, epoch, step_in_epoch)`` and enforces the step fence."""
-        template = self.state_factory()
+        ``(state, epoch, step_in_epoch)`` and enforces the step fence.
+        In elastic mode the restore template is built at the CHECKPOINT's
+        recorded world size and the state reshards into the current
+        layout when the worlds differ (the N -> M re-slice)."""
         among = None if self.trust_existing else self._saved_labels
-        restored = (self.ckpt.restore_latest(template, among=among)
-                    if self.ckpt is not None else None)
+        self._last_restore_label = None
+        if self.ckpt is None:
+            restored = None
+        elif self.replan_cb is not None:
+            restored = self.ckpt.restore_latest(
+                among=among, template_factory=self._template_for_world)
+        else:
+            restored = self.ckpt.restore_latest(self.state_factory(),
+                                                among=among)
         if self.ckpt is not None:
             # a torn checkpoint is skipped by EVERY later restore; count
             # distinct labels, not skip events
@@ -237,8 +328,31 @@ class Supervisor:
             if self.ckpt is not None:
                 log_main("supervisor: no valid checkpoint — "
                          "(re)starting from scratch")
-            return template, 0, 0
+            return self.state_factory(), 0, 0
         state, epoch, step = restored
+        self._last_restore_label = self.ckpt.last_restored
+        if self.replan_cb is not None:
+            ckpt_world = self.ckpt.checkpoint_world_size(
+                self._last_restore_label)
+            if (ckpt_world is not None and self._world is not None
+                    and ckpt_world != self._world):
+                # the elastic re-slice: old-N flat-padded layouts re-chunk
+                # into the new-M template, EF residual rows fold — exact
+                # (pad regions are zeros), one leaf at a time
+                from .elastic import reshard_train_state
+
+                with _telemetry.span("elastic_reshard",
+                                     from_world=ckpt_world,
+                                     to_world=self._world,
+                                     label=self._last_restore_label):
+                    state = reshard_train_state(
+                        state, ckpt_world, self._world, self.trainer,
+                        self.state_factory())
+                log_main(f"supervisor: resharded checkpoint "
+                         f"{self._last_restore_label} from world "
+                         f"{ckpt_world} to {self._world} (flat-padded "
+                         "re-slice; sampler/RNG unchanged behind the "
+                         "step fence)")
         expected = epoch * spe + step
         got = int(state.step)
         if got != expected:
@@ -348,7 +462,19 @@ class Supervisor:
                          f"{e}) — restart {report.restarts}/"
                          f"{self.retry.max_restarts} in {delay:.2f}s")
                 self.sleep(delay)
+                # elastic resize rides THIS restart (already counted,
+                # flighted, and backed off above — a resize is one
+                # restart, never two): re-plan the mesh to the surviving
+                # replica count, then restore-and-reshard below
+                resize = None
+                if (self.replan_cb is not None
+                        and isinstance(e, ReplicaDeathError)):
+                    resize = self._replan(e, report)
                 state, epoch, step = self._restore_or_fresh(report, spe)
+                if resize is not None:
+                    resize.update(label=self._last_restore_label,
+                                  epoch=epoch, step=step)
+                    report.resizes.append(resize)
                 restored_abs = epoch * spe + step
                 if self._last_step_entered >= 0:
                     report.steps_replayed += max(

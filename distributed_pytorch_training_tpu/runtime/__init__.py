@@ -1,8 +1,11 @@
 """Process/device runtime — TPU-native equivalent of the reference's L0 layer."""
 
 from .dist import (  # noqa: F401
+    COMPILE_CACHE_ENV,
     DistContext,
     cleanup_distributed,
+    compile_cache_dir,
+    compile_cache_mode,
     enable_persistent_compile_cache,
     honor_platform_env,
     is_distributed,

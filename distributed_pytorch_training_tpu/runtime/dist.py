@@ -51,31 +51,94 @@ def honor_platform_env() -> None:
             pass
 
 
-def enable_persistent_compile_cache(cache_dir) -> bool:
-    """Point XLA's persistent compile cache at ``cache_dir`` — accelerator
-    backends only. Returns True iff enabled.
+# The warm-restart compilation cache tri-state (ISSUE 11): elastic
+# resizes, supervisor restarts and serving-fleet autoscaling all pay a
+# full recompile of the (re)built step without it.
+#   auto (default/unset) — enable on accelerator backends only (the
+#        historical behavior: XLA:CPU reloads are unsafe, see below);
+#   on   — enable regardless of backend (the operator vouches for the
+#          environment; on CPU the known AOT-reload hazard applies);
+#   off  — never enable (debugging stale-cache suspicion).
+COMPILE_CACHE_ENV = "DPT_COMPILE_CACHE"
+_COMPILE_CACHE_MODES = ("auto", "on", "off")
 
-    Gated on the RESOLVED backend, not env vars: an accelerator-init failure
-    can silently fall back to XLA:CPU, whose persistent-cache reloads are
-    unsafe here — AOT entries record pseudo machine features
-    (+prefer-no-scatter/gather) that fail the feature match on reload, and
-    the mismatch-loaded executables desynchronized an 8-device collective
-    rendezvous into a fatal abort (observed 2026-07-31 on the virtual CPU
-    mesh: ``cpu_aot_loader.cc`` mismatch warnings, then ``rendezvous.cc``
-    termination). Call only when backend init is acceptable (touching
-    ``jax.default_backend()`` brings the backend up — on a wedged tunnel
-    that can block, so callers probe first; see bench.py).
+
+def compile_cache_mode(mode: Optional[str] = None) -> str:
+    """Resolve the tri-state: explicit ``mode`` wins, else the
+    ``DPT_COMPILE_CACHE`` env var, else "auto". Invalid values are a loud
+    error — a typo'd "ON " silently meaning auto would be the
+    silent-fallback class the analysis rules exist to kill."""
+    resolved = mode if mode is not None else \
+        os.environ.get(COMPILE_CACHE_ENV, "auto").strip().lower() or "auto"
+    if resolved not in _COMPILE_CACHE_MODES:
+        raise ValueError(
+            f"{COMPILE_CACHE_ENV}={resolved!r} is not one of "
+            f"{_COMPILE_CACHE_MODES}")
+    return resolved
+
+
+def compile_cache_dir(base_dir, topology: str, config_tag: str = ""):
+    """The (topology, config)-keyed cache directory: entries compiled for
+    one mesh shape / config never shadow another's (XLA's own cache key
+    covers the computation, but keying the DIRECTORY keeps an elastic
+    fleet's per-world entries enumerable and independently evictable).
+    Key components are sanitized to filesystem-safe tokens."""
+    import re as _re
+
+    def clean(s: str) -> str:
+        return _re.sub(r"[^A-Za-z0-9_.=-]+", "-", s).strip("-") or "default"
+
+    from pathlib import Path
+
+    name = clean(topology) + (f"__{clean(config_tag)}" if config_tag else "")
+    return Path(base_dir) / name
+
+
+def enable_persistent_compile_cache(cache_dir,
+                                    mode: Optional[str] = None) -> bool:
+    """Point XLA's persistent compile cache at ``cache_dir``. Returns True
+    iff enabled. ``mode`` is the ``DPT_COMPILE_CACHE`` tri-state (see
+    above; None reads the env var, default "auto").
+
+    In "auto", gated on the RESOLVED backend, not env vars: an
+    accelerator-init failure can silently fall back to XLA:CPU, whose
+    persistent-cache reloads are unsafe here — AOT entries record pseudo
+    machine features (+prefer-no-scatter/gather) that fail the feature
+    match on reload, and the mismatch-loaded executables desynchronized an
+    8-device collective rendezvous into a fatal abort (observed 2026-07-31
+    on the virtual CPU mesh: ``cpu_aot_loader.cc`` mismatch warnings, then
+    ``rendezvous.cc`` termination). Call only when backend init is
+    acceptable (touching ``jax.default_backend()`` brings the backend up —
+    on a wedged tunnel that can block, so callers probe first; see
+    bench.py). The verdict is recorded as a ``compile_cache_enabled``
+    telemetry counter so a restart-downtime A/B can attribute its win.
     """
+    resolved = compile_cache_mode(mode)
+    enabled = False
+    backend = ""
+    if resolved != "off":
+        try:
+            backend = jax.default_backend()
+            if resolved == "on" or backend != "cpu":
+                # dir LAST: the cache only activates once the dir is set,
+                # so a failure in either update leaves it off and the
+                # False is honest
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+                jax.config.update("jax_compilation_cache_dir",
+                                  str(cache_dir))
+                enabled = True
+        except Exception:
+            enabled = False
     try:
-        if jax.default_backend() == "cpu":
-            return False
-        # dir LAST: the cache only activates once the dir is set, so a
-        # failure in either update leaves it off and the False is honest
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
-        return True
-    except Exception:
-        return False
+        from .. import telemetry
+
+        telemetry.counter("compile_cache_enabled", int(enabled),
+                          mode=resolved, backend=backend,
+                          cache_dir=str(cache_dir))
+    except Exception:  # telemetry must never break backend setup
+        pass
+    return enabled
 
 
 @dataclasses.dataclass(frozen=True)

@@ -158,6 +158,18 @@ def _run(args, buckets) -> int:
     train_config = TrainConfig(
         seed=0, zero1=args.zero1, fsdp_explicit=args.fsdp_explicit,
         wire_dtype=args.wire_dtype, bucket_cap_mb=args.bucket_cap_mb)
+    # Warm-restart compilation cache, keyed by (topology, config): a
+    # restarted or autoscaled serving replica re-AOT-compiles its whole
+    # bucket ladder — with the persistent cache on, those compiles load
+    # from disk instead (the engine's per-program `compile` telemetry
+    # spans are the cold-vs-warm instrument). DPT_COMPILE_CACHE tri-state;
+    # "auto" refuses XLA:CPU (unsafe reloads — runtime.dist docstring).
+    from ..runtime import compile_cache_dir, enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(compile_cache_dir(
+        Path(args.output_dir) / ".jax_cache",
+        topology=f"{jax.default_backend()}-{len(jax.devices())}dev",
+        config_tag=f"{args.model}-{args.serve_dtype}-rows{args.rows}"))
 
     if args.command == "bench":
         row = measure_serving(

@@ -388,7 +388,15 @@ class InferenceEngine:
                 ).lower(self._served,
                         self._aval((rows, bucket), jnp.int32),
                         self._aval((rows,), jnp.int32))
-            self._compiled[key] = lowered.compile()
+            # the cold-vs-warm instrument: with the persistent compile
+            # cache on (DPT_COMPILE_CACHE / enable_persistent_compile_
+            # cache), a restarted/autoscaled engine's spans collapse from
+            # full-compile to cache-load time — the restart-downtime win,
+            # measurable per program in the stream
+            # attr named `program`, not `kind`: the recorder's emit() owns
+            # the `kind` parameter (event kind), attrs must not shadow it
+            with telemetry.span("compile", program=kind, bucket=bucket):
+                self._compiled[key] = lowered.compile()
             self.compiles += 1
         return self._compiled[key]
 

@@ -6,8 +6,11 @@ Also installed as the ``telemetry`` console script (pyproject.toml).
 Commands:
   summary <stream.jsonl> [--json]
       Per-phase step-time split (data_wait / step_dispatch / device_sync /
-      save_blocked / eval / restore, plus the serving phases queue_wait /
-      prefill / decode / drain), throughput, wire-byte totals, and
+      save_blocked / eval / restore, the serving phases queue_wait /
+      prefill / decode / drain, and the elastic phases elastic_replan /
+      elastic_reshard; `compile` spans show in the spans table but are
+      not summed — a lazy compile nests inside the span that triggered
+      it), throughput, wire-byte totals, and
       anomaly counts — the "gradient sync share of step" table the
       reference promised, computed from the stream's OWN recorded totals
       (the split is checked against the recorded epoch seconds; the
@@ -34,7 +37,7 @@ from collections import defaultdict
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from .recorder import SERVING_SPAN_NAMES, SPAN_NAMES
+from .recorder import ELASTIC_SPAN_NAMES, SERVING_SPAN_NAMES, SPAN_NAMES
 
 
 def read_stream(path: str) -> Tuple[List[dict], int]:
@@ -93,7 +96,8 @@ def summarize(events: List[dict]) -> dict:
     # the accounted total instead — percentages always close to 100.
     wall_ms = counters.get("epoch_time_s", 0.0) * 1e3
     accounted = {n: spans[n]["total_ms"]
-                 for n in SPAN_NAMES + SERVING_SPAN_NAMES if n in spans}
+                 for n in SPAN_NAMES + SERVING_SPAN_NAMES
+                 + ELASTIC_SPAN_NAMES if n in spans}
     accounted_ms = sum(accounted.values())
     split = {}
     base = max(wall_ms, accounted_ms)

@@ -69,6 +69,17 @@ _MANIFEST_DIRNAME = ".manifests"
 _MANIFEST_FORMAT = 1
 
 
+class CheckpointWorldSizeMismatch(RuntimeError):
+    """A checkpoint written at one DP world size was restored against a
+    template built for another — the flat-padded layouts (zero1 moments,
+    fsdp params+moments, EF residuals) change shape with the shard count,
+    so orbax's opaque tree-mismatch dump is really THIS error. Raised with
+    both sizes in the message; resolve by restoring through
+    ``restore_latest(template_factory=...)`` (build the template at the
+    checkpoint's recorded world size and reshard — resilience/elastic.py)
+    or by resuming at the original world size."""
+
+
 def _file_sha256(path: Path) -> str:
     # chunked: checkpoint data files are model-sized, and a whole-file
     # read_bytes() would spike host RAM by the checkpoint size on every
@@ -183,7 +194,25 @@ class CheckpointManager:
     def _pending_path(self, label: int) -> Path:
         return self._dir / _MANIFEST_DIRNAME / f"{label}.pending"
 
-    def _write_manifest(self, label: int, step: int) -> None:
+    @staticmethod
+    def _shape_summary(snapshot: dict) -> dict:
+        """Sorted per-subtree shape multisets of the state being saved —
+        recorded in the manifest so a cross-world restore can detect a
+        layout mismatch BEFORE orbax touches the arrays (orbax's own item
+        metadata is not reliably readable across versions, and its
+        StandardRestore silently TRUNCATES a flat-padded leaf into a
+        smaller template instead of failing)."""
+        out = {}
+        for key in ("params", "opt_state", "grad_sync"):
+            if key in snapshot:
+                out[key] = sorted(
+                    list(np.shape(leaf))
+                    for leaf in jax.tree_util.tree_leaves(snapshot[key]))
+        return out
+
+    def _write_manifest(self, label: int, step: int,
+                        world_size: Optional[int] = None,
+                        shapes: Optional[dict] = None) -> None:
         step_dir = self._step_dir(label)
         files = {}
         tree = hashlib.sha256()
@@ -198,6 +227,13 @@ class CheckpointManager:
         manifest = {"format": _MANIFEST_FORMAT, "label": label,
                     "step": int(step), "n_files": len(files),
                     "tree_digest": tree.hexdigest(), "files": files}
+        if world_size is not None:
+            # the DP world size (batch shards) the state was laid out for:
+            # the per-label probe elastic restores / template factories use
+            # to build a matching template (legacy manifests lack it)
+            manifest["world_size"] = int(world_size)
+        if shapes:
+            manifest["shapes"] = shapes
         path = self._manifest_path(label)
         path.parent.mkdir(parents=True, exist_ok=True)
         # atomic: a manifest torn by a crash mid-write must read as invalid
@@ -282,7 +318,8 @@ class CheckpointManager:
                  f"({type(err).__name__}: {err}) — it will be skipped by "
                  "integrity verification")
 
-    def _write_job(self, label: int, snapshot: dict, step_value: int) -> None:
+    def _write_job(self, label: int, snapshot: dict, step_value: int,
+                   world_size: Optional[int] = None) -> None:
         """Everything after the snapshot: orbax write + finalize, the
         manifest, the pending-marker removal, and the hooks. Runs on the
         writer thread (async) or inline (sync / ``wait=True``)."""
@@ -299,15 +336,18 @@ class CheckpointManager:
         # makes a GOOD checkpoint skip forever. Verification stays on
         # every process (read-only; all reach the same verdict).
         if jax.process_index() == 0:
-            self._write_manifest(label, step=step_value)
+            self._write_manifest(label, step=step_value,
+                                 world_size=world_size,
+                                 shapes=self._shape_summary(snapshot))
             self._pending_path(label).unlink(missing_ok=True)
         if self._post_save_hook is not None:
             self._post_save_hook(label, self._step_dir(label))
 
-    def _writer_main(self, label: int, snapshot: dict,
-                     step_value: int) -> None:
+    def _writer_main(self, label: int, snapshot: dict, step_value: int,
+                     world_size: Optional[int] = None) -> None:
         try:
-            self._write_job(label, snapshot, step_value)
+            self._write_job(label, snapshot, step_value,
+                            world_size=world_size)
         except BaseException as e:  # surfaced at the next barrier
             self._writer_error = e
             self._writer_label = label
@@ -315,7 +355,8 @@ class CheckpointManager:
     # -- save / restore ----------------------------------------------------
 
     def save(self, label: int, state: TrainState, wait: bool = False,
-             epoch: Optional[int] = None, step_in_epoch: int = 0) -> None:
+             epoch: Optional[int] = None, step_in_epoch: int = 0,
+             world_size: Optional[int] = None) -> None:
         """`epoch` defaults to `label` (the legacy epoch-granular callers
         label saves by completed-epoch count). Snapshot-then-write: the
         device→host copy happens HERE (the train step donates these
@@ -324,7 +365,10 @@ class CheckpointManager:
         ``wait=True`` or the manager was built ``async_save=False``.
         Joins (and surfaces the failure of) any previous in-flight write
         first. Re-saving an existing label (the supervisor replaying over
-        a torn save) replaces the whole step."""
+        a torn save) replaces the whole step. ``world_size`` (the DP batch
+        shard count the state is laid out for) is recorded in the manifest
+        so cross-world restores — elastic resizes — can probe it per label
+        (`checkpoint_world_size`) and build a matching template."""
         t0 = time.perf_counter()
         self._join_writer()
         if label in self._mgr.all_steps():
@@ -348,12 +392,14 @@ class CheckpointManager:
                 {"label": label, "step": step_value}))
         if self._async and not wait:
             t = threading.Thread(
-                target=self._writer_main, args=(label, snapshot, step_value),
+                target=self._writer_main,
+                args=(label, snapshot, step_value, world_size),
                 name=f"ckpt-writer-{label}", daemon=True)
             self._writer = t
             t.start()
         else:
-            self._write_job(label, snapshot, step_value)
+            self._write_job(label, snapshot, step_value,
+                            world_size=world_size)
         blocked_s = time.perf_counter() - t0
         self.save_blocked_ms += blocked_s * 1e3
         # the save_blocked telemetry span: exactly the caller-thread stall
@@ -362,8 +408,61 @@ class CheckpointManager:
                              phase="save",
                              async_save=bool(self._async and not wait))
 
+    def _template_shapes_differ(self, label: int,
+                                template: TrainState) -> bool:
+        """Whether the checkpoint's saved array shapes differ from the
+        template's — compared as per-subtree shape MULTISETS, so the
+        replicated layout (whose shapes are world-size independent)
+        restores across worlds unharassed while a flat-padded layout's
+        changed padding is caught. Shapes come from OUR manifest (the
+        `shapes` field `_write_manifest` records) — orbax's item metadata
+        is not reliably readable across versions, and this check is what
+        stands between a cross-world restore and StandardRestore's silent
+        truncation. False when no shape record exists (legacy manifest:
+        the restore then proceeds on its own merits)."""
+        manifest = self.manifest(label)
+        saved = (manifest or {}).get("shapes")
+        if not saved:
+            return False
+
+        def shapes(tree) -> List[list]:
+            return sorted(
+                list(np.shape(leaf))
+                for leaf in jax.tree_util.tree_leaves(tree))
+
+        try:
+            # grad_sync is compared too: the replicated+int8 layout's
+            # params/opt_state are world-independent — ONLY its (n, R)
+            # EF residual rows change with the world, and orbax would
+            # truncate them just as silently. (A cross-world restore that
+            # ALSO toggles compression trips this check as well — that
+            # combination has no supported restore path, and the named
+            # error beats orbax's structure dump.)
+            for key, want in saved.items():
+                if shapes(getattr(template, key)) != sorted(
+                        list(s) for s in want):
+                    return True
+        except Exception:
+            return False
+        return False
+
+    def checkpoint_world_size(self, label: Optional[int]) -> Optional[int]:
+        """The DP world size (batch shards) checkpoint ``label`` was saved
+        under, from its manifest — None for legacy manifests (written
+        before the field existed), manifest-less checkpoints, or a None
+        label. The per-label probe elastic restores key their template
+        (and reshard decision) on."""
+        if label is None:
+            return None
+        manifest = self.manifest(label)
+        if manifest is None:
+            return None
+        w = manifest.get("world_size")
+        return int(w) if w is not None else None
+
     def restore_latest(
-        self, template: TrainState, among=None,
+        self, template: Optional[TrainState] = None, among=None,
+        template_factory=None, template_world_size: Optional[int] = None,
     ) -> Optional[Tuple[TrainState, int, int]]:
         """Returns (state, epoch, step_in_epoch) from the newest checkpoint
         that PASSES integrity verification, or None if none exists (torn
@@ -377,7 +476,20 @@ class CheckpointManager:
         directory can never leak into a fresh trajectory. Any in-flight
         async write is joined first (a restore must never race the
         writer); its failure, if any, is logged, not raised — a failed
-        save is exactly a torn checkpoint, handled below."""
+        save is exactly a torn checkpoint, handled below.
+
+        World sizes: ``template_factory(world)`` (instead of ``template``)
+        builds the template PER CANDIDATE from the manifest's recorded
+        world size (None for legacy manifests) — the elastic-restore path:
+        a checkpoint written at 8 replicas restores into an 8-world
+        template even when the run now holds 4 (the caller reshards,
+        resilience/elastic.py). With a plain ``template``,
+        ``template_world_size`` turns orbax's opaque structure-mismatch
+        dump into :class:`CheckpointWorldSizeMismatch` naming both sizes
+        whenever the manifest proves the worlds really differ."""
+        if (template is None) == (template_factory is None):
+            raise ValueError("restore_latest needs exactly one of "
+                             "`template` or `template_factory`")
         self._join_writer(reraise=False)
         self.last_skipped = []
         labels = sorted((label for label in self._mgr.all_steps()
@@ -392,7 +504,32 @@ class CheckpointManager:
                                label=label, problem=problem)
                 self.last_skipped.append(label)
                 continue
-            return self._restore(label, template)
+            saved_world = self.checkpoint_world_size(label)
+            if template_factory is not None:
+                tmpl = template_factory(saved_world)
+            else:
+                tmpl = template
+                if (saved_world is not None
+                        and template_world_size is not None
+                        and saved_world != template_world_size
+                        and self._template_shapes_differ(label, tmpl)):
+                    # MUST be checked before the restore: orbax does not
+                    # reliably reject a shape mismatch — StandardRestore
+                    # can silently truncate a flat-padded leaf into the
+                    # smaller-world template, which corrupts the state
+                    # instead of failing
+                    raise CheckpointWorldSizeMismatch(
+                        f"checkpoint {label} was written at world size "
+                        f"{saved_world} (DP batch shards), but the "
+                        "restore template was built for world size "
+                        f"{template_world_size} — flat-padded layouts "
+                        "(zero1 moments, fsdp params, EF residuals) "
+                        "change shape with the DP degree. Restore with a "
+                        f"template built at world size {saved_world} "
+                        "(restore_latest(template_factory=...)) and "
+                        "reshard via resilience.elastic, or resume at "
+                        "the original world size")
+            return self._restore(label, tmpl)
         if self.last_skipped:
             log_main(f"CHECKPOINT INTEGRITY: every checkpoint "
                      f"({self.last_skipped}) failed verification — "

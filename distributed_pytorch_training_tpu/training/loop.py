@@ -309,6 +309,17 @@ class Trainer:
         self._train_step = jax.jit(self._train_step_impl, donate_argnums=donate)
         self._eval_step = jax.jit(self._eval_step_impl)
 
+    @property
+    def batch_shards(self) -> int:
+        """The DP world size this trainer's step was built for (product of
+        the mesh's batch axes). The restart Supervisor records it in every
+        checkpoint manifest and re-plans against it on an elastic resize —
+        the per-step RNG (folded from ``state.step``) and the sampler
+        (seeded by seed+epoch at a FIXED global batch) are world-size-
+        independent, so a resharded restore replays the same trajectory
+        behind the same step fence."""
+        return self._zero1_n
+
     def set_mfu_reference(self, flops_per_sample: float,
                           peak_flops_total: float) -> None:
         """Enable MFU in the step log: `flops_per_sample` is the analytic

@@ -128,6 +128,32 @@ class TestFaultPlan:
         assert inj.fired == ["crash@step=3"]
         assert inj.unfired() == []
 
+    def test_repeat_counts_parse_and_fire_per_occurrence(self):
+        """ISSUE-11 satellite: `kind@trigger=N xK` fires K times, one per
+        matching trigger occurrence (the elastic replay re-crosses the
+        fence), then is spent; existing one-shot specs are unchanged."""
+        plan = FaultPlan.parse("replica_death@step=3x2, crash@step=5")
+        assert [f.count for f in plan.faults] == [2, 1]
+        # the spec-form label reports the REMAINING repeats
+        assert plan.faults[0].label(remaining=2) == "replica_death@step=3x2"
+        inj = FaultInjector(plan, log=lambda _m: None)
+        from distributed_pytorch_training_tpu.resilience.faults import (
+            ReplicaDeathError,
+        )
+
+        for _ in range(2):
+            with pytest.raises(ReplicaDeathError, match="replica_death"):
+                inj.on_step(3)
+        inj.on_step(3)  # spent: the third crossing passes
+        assert inj.fired == ["replica_death@step=3"] * 2
+        assert inj.unfired() == ["crash@step=5"]
+        # space form parses too (the ISSUE's `kind@trigger=N xK` spelling)
+        assert FaultPlan.parse("crash@step=3 x2").faults[0].count == 2
+
+    def test_repeat_count_zero_is_loud(self):
+        with pytest.raises(ValueError, match="repeat count"):
+            FaultPlan.parse("crash@step=3x0")
+
     def test_loader_stall_sleeps_once(self):
         inj = FaultInjector(FaultPlan.parse("loader_stall@step=1:0.15s"),
                             log=lambda _m: None)
@@ -587,6 +613,61 @@ class TestSupervisor:
         assert report.faults_fired == ["loader_stall@step=1:0.2s"]
         assert int(state.step) == 4
 
+    def test_elastic_resize_one_restart_one_flight_deterministic_jitter(
+            self, rig, tmp_path):
+        """ISSUE-11 satellite: a restart that RESIZES rides the normal
+        retry path — exactly one restart counted, one flight flushed (its
+        cause quotes the replica_death label), and the RetryPolicy's
+        deterministic jitter is the one backoff slept. The resize record
+        lands in report.resizes (label None: no checkpoint manager, the
+        restart is from scratch at the new world)."""
+        import random
+
+        from distributed_pytorch_training_tpu import telemetry
+        from distributed_pytorch_training_tpu.parallel import (
+            MeshSpec, build_mesh,
+        )
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            _build_rig,
+        )
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            ElasticPlan,
+        )
+
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("replica_death@step=1"),
+                            log=lambda _m: None)
+        mesh4 = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        # same GLOBAL batch (16): per-device batch doubles at world 4
+        t4, sf4, l4 = _build_rig(mesh4, seed=0, dataset_size=64,
+                                 per_device_batch=4)
+
+        def replan(survivors):
+            assert survivors == 7  # world 8 minus the dead replica
+            return ElasticPlan(trainer=t4, loader=l4, state_factory=sf4,
+                               world=4)
+
+        sleeps = []
+        telemetry.configure(str(tmp_path / "telemetry.jsonl"))
+        try:
+            sup = Supervisor(trainer, None, state_factory,
+                             make_loader(inj.on_loader_batch),
+                             retry=_FAST_RETRY, injector=inj,
+                             replan_cb=replan, sleep=sleeps.append)
+            state, report = sup.run(epochs=1)
+        finally:
+            telemetry.reset()
+        assert report.completed and report.restarts == 1
+        assert report.resizes == [{"from_world": 8, "to_world": 4,
+                                   "survivors": 7, "label": None,
+                                   "epoch": 0, "step": 0}]
+        assert int(state.step) == 4  # the full epoch ran at world 4
+        flights = sorted(tmp_path.glob("flight_*.json"))
+        assert len(flights) == 1
+        assert "replica_death@step=1" in flights[0].read_text()
+        expect = _FAST_RETRY.delay_s(1, random.Random(_FAST_RETRY.seed))
+        assert sleeps == [expect]  # jitter stays deterministic
+
     def test_retry_policy_backoff_is_bounded_and_jittered(self):
         import random
 
@@ -629,6 +710,80 @@ def test_chaos_cli_recovers_and_verifies_parity(tmp_path, capsys):
     assert stats["flights_ok"] is True
     assert any("crash@step=2" in (f["cause"] or "")
                for f in stats["flights"])
+
+
+def _chaos_elastic(tmp_path, capsys, *extra):
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    rc = main(["chaos", "--elastic", "--ckpt-dir", str(tmp_path / "ckpt"),
+               "--json", *extra])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    return rc, stats
+
+
+def test_chaos_cli_elastic_resize_bitwise_parity(tmp_path, capsys):
+    """ISSUE-11 acceptance (the tier-1 elastic smoke): replica_death
+    mid-epoch under `resilience chaos --elastic` — the run re-plans
+    8 -> 4 replicas (7 survivors; 4 is the largest divisor of the fixed
+    global batch), reshards the checkpoint, completes, records the resize
+    in the RunReport, leaves a replica_death flight, and the post-resize
+    segment is BITWISE equal to a clean same-seed continuation at the
+    shrunken world."""
+    rc, stats = _chaos_elastic(tmp_path, capsys)
+    assert rc == 0
+    assert stats["completed"] is True
+    assert stats["parity_bitwise"] is True
+    assert stats["restarts"] == 1
+    assert stats["faults_fired"] == ["replica_death@step=3"]
+    assert stats["resizes"] == [{"from_world": 8, "to_world": 4,
+                                 "survivors": 7, "label": 2,
+                                 "epoch": 0, "step": 2}]
+    assert stats["flights_ok"] is True
+    assert any("replica_death" in (f["cause"] or "")
+               for f in stats["flights"])
+
+
+def test_chaos_cli_elastic_zero1_int8_ef_residuals(tmp_path, capsys):
+    """The elastic reshard carries the FULL zero1 state across the resize
+    — flat-padded moments AND the int8 wire's error-feedback residuals —
+    and the post-resize segment still pins bitwise (the acceptance's
+    'EF residuals included')."""
+    rc, stats = _chaos_elastic(tmp_path, capsys,
+                               "--layout", "zero1",
+                               "--wire-dtype", "int8")
+    assert rc == 0
+    assert stats["completed"] and stats["parity_bitwise"] is True
+    assert stats["resizes"] and stats["resizes"][0]["to_world"] == 4
+
+
+@pytest.mark.slow
+def test_chaos_cli_elastic_fsdp_int8(tmp_path, capsys):
+    """Explicit FSDP across a resize: flat-sharded params + moments +
+    per-group EF residuals all re-slice, post-resize bitwise parity."""
+    rc, stats = _chaos_elastic(tmp_path, capsys,
+                               "--layout", "fsdp",
+                               "--wire-dtype", "int8")
+    assert rc == 0
+    assert stats["completed"] and stats["parity_bitwise"] is True
+
+
+@pytest.mark.slow
+def test_chaos_cli_elastic_double_resize(tmp_path, capsys):
+    """The repeat-count schedule `replica_death@step=3x2`: the replay
+    re-crosses the fence, the mesh shrinks twice (8 -> 4 -> 2), two
+    flights land, and the post-LAST-resize segment pins bitwise (the
+    control probes the checkpoint's OWN recorded world — the restored
+    label may predate the first resize)."""
+    rc, stats = _chaos_elastic(tmp_path, capsys,
+                               "--chaos", "replica_death@step=3x2",
+                               "--layout", "zero1",
+                               "--wire-dtype", "int8")
+    assert rc == 0
+    assert stats["completed"] and stats["parity_bitwise"] is True
+    assert [r["to_world"] for r in stats["resizes"]] == [4, 2]
+    assert stats["restarts"] == 2
+    causes = [f["cause"] or "" for f in stats["flights"]]
+    assert sum("replica_death" in c for c in causes) == 2
 
 
 @pytest.mark.slow

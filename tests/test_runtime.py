@@ -53,7 +53,8 @@ def test_persistent_compile_cache_refuses_cpu_backend(tmp_path):
     """XLA:CPU persistent-cache reloads are unsafe (AOT pseudo-feature
     mismatch desynchronized a collective rendezvous into a fatal abort —
     runtime.dist.enable_persistent_compile_cache docstring). On the CPU
-    test backend the helper must refuse and leave the config untouched."""
+    test backend the helper must refuse (in the default "auto" mode) and
+    leave the config untouched."""
     import jax
 
     from distributed_pytorch_training_tpu.runtime import (
@@ -64,3 +65,55 @@ def test_persistent_compile_cache_refuses_cpu_backend(tmp_path):
     assert enable_persistent_compile_cache(tmp_path / "cache") is False
     assert jax.config.jax_compilation_cache_dir == before
     assert not (tmp_path / "cache").exists()
+
+
+def test_compile_cache_tristate(tmp_path, monkeypatch):
+    """ISSUE-11: the DPT_COMPILE_CACHE tri-state — "off" never enables,
+    "on" forces (the operator vouches), invalid values are loud, unset
+    resolves to "auto" (the backend-gated historical behavior)."""
+    import jax
+    import pytest
+
+    from distributed_pytorch_training_tpu.runtime import (
+        COMPILE_CACHE_ENV, compile_cache_mode,
+        enable_persistent_compile_cache,
+    )
+
+    dir_before = jax.config.jax_compilation_cache_dir
+    min_before = jax.config.jax_persistent_cache_min_compile_time_secs
+
+    monkeypatch.setenv(COMPILE_CACHE_ENV, "off")
+    assert compile_cache_mode() == "off"
+    assert enable_persistent_compile_cache(tmp_path / "c") is False
+    assert jax.config.jax_compilation_cache_dir == dir_before
+
+    monkeypatch.setenv(COMPILE_CACHE_ENV, "maybe")
+    with pytest.raises(ValueError, match="DPT_COMPILE_CACHE"):
+        compile_cache_mode()
+
+    monkeypatch.delenv(COMPILE_CACHE_ENV, raising=False)
+    assert compile_cache_mode() == "auto"
+    assert compile_cache_mode("on") == "on"  # explicit arg beats the env
+
+    try:
+        assert enable_persistent_compile_cache(tmp_path / "c",
+                                               mode="on") is True
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "c")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", dir_before)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_before)
+
+
+def test_compile_cache_dir_is_keyed_and_sanitized(tmp_path):
+    """(topology, config) key one directory each; key components become
+    filesystem-safe tokens."""
+    from distributed_pytorch_training_tpu.runtime import compile_cache_dir
+
+    a = compile_cache_dir(tmp_path, "cpu-8dev", "gpt2 124m/zero1")
+    b = compile_cache_dir(tmp_path, "cpu-4dev", "gpt2 124m/zero1")
+    c = compile_cache_dir(tmp_path, "cpu-8dev", "gpt2 124m/fsdp")
+    assert len({a, b, c}) == 3
+    assert a.parent == b.parent == tmp_path
+    for p in (a, b, c):
+        assert "/" not in p.name and " " not in p.name

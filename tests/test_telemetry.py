@@ -247,6 +247,32 @@ class TestCli:
         assert dw["dur"] == pytest.approx(10_000, rel=1e-3)
         assert dw["ts"] > 1e15  # wall-clock us (aligns with an XLA trace)
 
+    def test_elastic_spans_are_bucketed_compile_is_not_double_counted(
+            self):
+        """ISSUE-11 satellite: the resize/reshard span names are canonical
+        phases — `telemetry summary` buckets them into the step-time split
+        instead of lumping them into unaccounted. The `compile` span is
+        deliberately EXCLUDED from the accounted sum (a lazy compile runs
+        inside the prefill/decode/step_dispatch span that triggered it —
+        summing it as its own phase would double-count the wall) but stays
+        visible in the spans table."""
+        events = [
+            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
+            {"kind": "span", "name": "elastic_replan", "dur_ms": 100.0},
+            {"kind": "span", "name": "elastic_reshard", "dur_ms": 200.0},
+            # 700ms dispatch that INCLUDES a 300ms nested compile
+            {"kind": "span", "name": "step_dispatch", "dur_ms": 700.0},
+            {"kind": "span", "name": "compile", "dur_ms": 300.0},
+        ]
+        s = summarize(events)
+        split = s["step_split_pct"]
+        assert split["elastic_replan"] == 10.0
+        assert split["elastic_reshard"] == 20.0
+        assert split["step_dispatch"] == 70.0
+        assert "compile" not in split          # no double-count
+        assert "unaccounted" not in split      # phases close to 100 exactly
+        assert s["spans"]["compile"]["total_ms"] == 300.0  # still visible
+
     def test_torn_stream_still_summarizes(self, tmp_path):
         p = self._stream(tmp_path)
         with open(p, "a") as f:

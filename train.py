@@ -191,16 +191,31 @@ def _run(args, guard):
     else:
         Deathwatch.arm(log=log_main)
     set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
-    # Reuse compiles across CLI invocations on accelerators (the TPU analogue
-    # of the reference's cudnn.benchmark=True autotune persistence, ref :329).
-    # Repo-local like bench.py/__graft_entry__.py — a per-output-dir cache
-    # would start empty for every fresh experiment dir. Self-gating: refuses
-    # XLA:CPU, whose cache reloads are unsafe here.
-    enable_persistent_compile_cache(
-        Path(__file__).resolve().parent / ".jax_cache")
     mesh = build_mesh(MeshSpec.parse(args.mesh))
     n_batch_shards = batch_shard_count(mesh)
     global_batch = args.batch_size * n_batch_shards
+    # Warm-restart compilation cache: reuse compiles across CLI invocations
+    # AND across supervisor/elastic restarts (the TPU analogue of the
+    # reference's cudnn.benchmark=True autotune persistence, ref :329).
+    # Repo-local like bench.py/__graft_entry__.py — a per-output-dir cache
+    # would start empty for every fresh experiment dir — and keyed by
+    # (topology, config) so one mesh shape's entries never shadow
+    # another's (the elastic-fleet story: each surviving world keeps its
+    # own warm entries). DPT_COMPILE_CACHE ∈ {auto,on,off}; "auto"
+    # refuses XLA:CPU, whose cache reloads are unsafe here. The verdict is
+    # a `compile_cache_enabled` telemetry counter.
+    from distributed_pytorch_training_tpu.runtime import compile_cache_dir
+    enable_persistent_compile_cache(compile_cache_dir(
+        Path(__file__).resolve().parent / ".jax_cache",
+        topology=f"{jax.default_backend()}-"
+                 + "-".join(f"{a}{s}" for a, s in sorted(mesh.shape.items())
+                            if s > 1 or a == "data"),
+        config_tag=f"{args.model}"
+                   + ("-zero1" if args.zero1 else "")
+                   + ("-fsdp" if args.fsdp_explicit else "")
+                   + (f"-{args.wire_dtype}" if args.wire_dtype != "fp32"
+                      else "")
+                   + ("-amp" if args.amp else "")))
 
     # Banner ≙ ref :326-327 ("Using device: ..., world_size=..., amp=...").
     dev0 = mesh.devices.flat[0]
@@ -565,8 +580,17 @@ def _run(args, guard):
             post_save_hook=chaos.on_save if chaos else None,
             pre_finalize_hook=chaos.on_save_finalize if chaos else None)
         if args.resume:
+            from distributed_pytorch_training_tpu.training.checkpoint import (
+                CheckpointWorldSizeMismatch,
+            )
             try:
-                restored = ckpt.restore_latest(state)
+                restored = ckpt.restore_latest(
+                    state, template_world_size=n_batch_shards)
+            except CheckpointWorldSizeMismatch:
+                # already a precise, named diagnosis (both world sizes in
+                # the message) — the generic mesh-hint wrapper below would
+                # only bury it
+                raise
             except Exception as e:
                 # Param SHAPES depend on the TP layout (vocab padding is
                 # lcm(128, model-axis)): resuming under a different --mesh
@@ -707,7 +731,8 @@ def _run(args, guard):
                           f"step {abs_step}", rc=0)
                 if ckpt:
                     ckpt.save(epoch * steps_per_epoch + abs_step, state,
-                              wait=True, epoch=epoch, step_in_epoch=abs_step)
+                              wait=True, epoch=epoch, step_in_epoch=abs_step,
+                              world_size=n_batch_shards)
                     log_main(f"Preempted: checkpointed epoch {epoch} step "
                              f"{abs_step}/{steps_per_epoch}; relaunch with "
                              "--resume to continue mid-epoch")
@@ -739,7 +764,8 @@ def _run(args, guard):
                                 epoch=epoch)
 
             if ckpt and (epoch + 1) % args.checkpoint_every == 0:
-                ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1)
+                ckpt.save((epoch + 1) * steps_per_epoch, state, epoch=epoch + 1,
+                          world_size=n_batch_shards)
 
             if guard.should_stop:
                 telemetry.flush_flight(
@@ -748,7 +774,8 @@ def _run(args, guard):
                 if ckpt:
                     if (epoch + 1) % args.checkpoint_every != 0:  # not saved above
                         ckpt.save((epoch + 1) * steps_per_epoch, state,
-                                  epoch=epoch + 1)
+                                  epoch=epoch + 1,
+                                  world_size=n_batch_shards)
                     ckpt.wait()
                     log_main(f"Preempted: checkpointed epoch {epoch + 1}; "
                              "relaunch with --resume to continue")
