@@ -138,9 +138,10 @@ class Contract:
     KV-cache decode step (`hlo_rules.evaluate_serving_contract`) — the
     decode-step contract of serving/ (ISSUE 10), run by the same tier-1
     ``analysis check`` gate; "elastic" lowers the SAME train step twice at
-    the halved world — once from a clean state, once from a state
-    resharded down by resilience.elastic — and pins the censuses equal
-    (`hlo_rules.evaluate_elastic_contract`, ISSUE 11).
+    the target world — once from a clean state, once from a state
+    resharded by resilience.elastic (down N->M for ``elastic_reshard``,
+    UP M->N for ``elastic_grow``) — and pins the censuses equal
+    (`hlo_rules.evaluate_elastic_contract`, ISSUEs 11 + 12).
     """
 
     name: str
@@ -244,6 +245,15 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "a reshardedN->M train step's collective census matches the "
              "clean-at-M census (no reshard-smuggled collectives)",
              config=dict(elastic_reshard=True, zero1=True),
+             min_shards=4, kind="elastic"),
+    # The GROW leg (ISSUE 12): the same pin in the capacity-return
+    # direction — a state grown M -> N (zero-extended flat shards +
+    # zero-extended EF rows, the supervisor's boundary grow) must lower
+    # to EXACTLY the clean-at-N census.
+    Contract("elastic_grow",
+             "a grown M->N train step's collective census matches the "
+             "clean-at-N census (no grow-smuggled collectives)",
+             config=dict(elastic_grow=True, zero1=True),
              min_shards=4, kind="elastic"),
 )
 

@@ -681,13 +681,22 @@ def check_decode_cache_donated(a: StepArtifacts) -> List[Finding]:
 def check_elastic_reshard_census(a: StepArtifacts) -> List[Finding]:
     if not a.config.get("elastic_reshard"):
         return []
+    return _elastic_census_findings(a, "elastic-reshard-census",
+                                    "clean-at-M")
+
+
+def _elastic_census_findings(a: StepArtifacts, rule_name: str,
+                             clean_noun: str) -> List[Finding]:
+    """The shared census pin of both elastic directions: the resharded
+    state's lowered step must carry EXACTLY the clean-world census
+    (``elastic_expected_census``, embedded by the evaluator)."""
     expected = a.config.get("elastic_expected_census")
     if expected is None:
         return [Finding(
-            "elastic-reshard-census",
-            "elastic_reshard config evaluated without a clean-at-M "
-            "expected census — the evaluator must lower the clean state "
-            "and snapshot its collective_census", a.name)]
+            rule_name,
+            f"elastic config evaluated without a {clean_noun} expected "
+            "census — the evaluator must lower the clean state and "
+            "snapshot its collective_census", a.name)]
     got = collective_census(a.optimized_text)
 
     def keyed(rows):
@@ -700,12 +709,29 @@ def check_elastic_reshard_census(a: StepArtifacts) -> List[Finding]:
         missing = {k: v for k, v in want_k.items()
                    if v != got_k.get(k, 0)}
         return [Finding(
-            "elastic-reshard-census",
+            rule_name,
             "resharded step's collective census differs from the "
-            f"clean-at-M census — resharded-only/changed: {extra}; "
+            f"{clean_noun} census — resharded-only/changed: {extra}; "
             f"clean-only/changed: {missing}. The reshard smuggled data "
             "movement into (or dropped it from) the step", a.name)]
     return []
+
+
+@rule("elastic-grow-census", "hlo",
+      "a grown M->N state's train step carries exactly the clean-at-N "
+      "collective census",
+      "the GROW leg of the elastic contract (ISSUE 12): a state resharded "
+      "UP when preempted capacity returns (zero-extended flat shards, "
+      "zero-extended EF rows) must lower to EXACTLY the census a "
+      "clean-at-N state lowers to — a grow that lands a leaf replicated "
+      "or off-layout would smuggle data movement into every post-grow "
+      "step while the resize claims a pure re-slice "
+      "(resilience/capacity.py + supervisor._maybe_grow).")
+def check_elastic_grow_census(a: StepArtifacts) -> List[Finding]:
+    if not a.config.get("elastic_grow"):
+        return []
+    return _elastic_census_findings(a, "elastic-grow-census",
+                                    "clean-at-N")
 
 
 @rule("no-host-transfer", "hlo",
@@ -877,15 +903,18 @@ def evaluate_serving_contract(contract: Contract,
 
 def evaluate_elastic_contract(contract: Contract,
                               mesh=None) -> StepArtifacts:
-    """The ``kind="elastic"`` evaluator (ISSUE 11): build the tiny
-    contract state at the FULL world N, reshard it down to M = N/2 through
-    the real elastic path (resilience.elastic.reshard_train_state — the
-    same code a Supervisor resize runs), lower the M-world trainer's step
-    on the resharded state, and snapshot its artifacts with the CLEAN
-    clean-at-M census embedded as the expectation
-    (``elastic_expected_census``). jit lowering keys on avals + shardings
-    only, so census equality holds iff the reshard landed every leaf in
-    the canonical M-world layout."""
+    """The ``kind="elastic"`` evaluator (ISSUEs 11 + 12), both
+    directions. SHRINK (``elastic_reshard``): build the tiny contract
+    state at the FULL world N, reshard it down to M = N/2 through the
+    real elastic path (resilience.elastic.reshard_train_state — the same
+    code a Supervisor resize runs), lower the M-world trainer's step on
+    the resharded state, and snapshot its artifacts with the clean-at-M
+    census embedded as the expectation (``elastic_expected_census``).
+    GROW (``elastic_grow``): the mirror — build at M = N/2, reshard UP to
+    N (zero-extended shards/EF rows, the capacity-return resize), lower
+    the N-world trainer's step, expect the clean-at-N census. jit
+    lowering keys on avals + shardings only, so census equality holds iff
+    the reshard landed every leaf in the canonical target-world layout."""
     import jax
 
     from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
@@ -903,14 +932,22 @@ def evaluate_elastic_contract(contract: Contract,
     sub_mesh = build_mesh(MeshSpec(),
                           devices=list(mesh.devices.flat)[:m])
     train_cfg = {k: v for k, v in contract.config.items()
-                 if k != "elastic_reshard"}
-    _trainer_n, state_n, _ = _tiny_lm_setup(mesh, train_cfg)
+                 if k not in ("elastic_reshard", "elastic_grow")}
+    grow = bool(contract.config.get("elastic_grow"))
+    trainer_n, state_n, batch_n = _tiny_lm_setup(mesh, train_cfg)
     trainer_m, state_m, batch_m = _tiny_lm_setup(sub_mesh, train_cfg)
-    resharded = reshard_train_state(state_n, n, m, trainer_m, state_m)
+    if grow:
+        resharded = reshard_train_state(state_m, m, n, trainer_n, state_n)
+        clean_trainer, clean_state, batch = trainer_n, state_n, batch_n
+        out_shards = n
+    else:
+        resharded = reshard_train_state(state_n, n, m, trainer_m, state_m)
+        clean_trainer, clean_state, batch = trainer_m, state_m, batch_m
+        out_shards = m
     key = jax.random.PRNGKey(1)
-    clean_text = trainer_m._train_step.lower(
-        state_m, batch_m, key).compile().as_text()
-    lowered = trainer_m._train_step.lower(resharded, batch_m, key)
+    clean_text = clean_trainer._train_step.lower(
+        clean_state, batch, key).compile().as_text()
+    lowered = clean_trainer._train_step.lower(resharded, batch, key)
     optimized = lowered.compile().as_text()
     try:
         preopt = preopt_hlo_text(lowered)
@@ -922,7 +959,7 @@ def evaluate_elastic_contract(contract: Contract,
         preopt_text=preopt,
         config={**contract.config,
                 "elastic_expected_census": collective_census(clean_text)},
-        n_shards=m,
+        n_shards=out_shards,
         min_elements=contract.min_elements,
         backend=jax.default_backend(),
     )

@@ -24,12 +24,28 @@ anyway", composing three pieces that previously existed only in isolation:
   exponential-backoff-with-jitter ``RetryPolicy``, draining preemptions
   gracefully instead of racing them.
 
+Elasticity is BIDIRECTIONAL (ISSUE 11 shrank, ISSUE 12 grows and crosses
+process boundaries):
+
+* :mod:`.elastic` — the N↔M reshard orchestration (``plan_elastic_world``,
+  ``reshard_train_state``, the raw cross-process variant
+  ``reshard_raw_state``);
+* :mod:`.capacity` — the grow-side analog of the Deathwatch: a pollable
+  ``CapacityWatch`` registry the ``capacity_return@step=k`` chaos fault
+  (or a real cluster probe) feeds, polled by the Supervisor at segment
+  boundaries to re-plan UP when preempted capacity returns;
+* :mod:`.fleet` — the cross-PROCESS orchestrator: launches ``train.py``
+  children, watches exit codes, and relaunches with a *different* world
+  size over the shared checkpoint directory (``resilience fleet``).
+
 ``python -m distributed_pytorch_training_tpu.resilience chaos`` (also the
 ``resilience`` console script) runs a scripted fault schedule against a
 short CPU-mesh training run and reports recovery stats — the demo and the
-test harness in one.
+test harness in one; ``resilience fleet`` runs the subprocess-relaunch
+scenario end to end.
 """
 
+from .capacity import CapacityWatch  # noqa: F401
 from .faults import FaultError, FaultInjector, FaultPlan  # noqa: F401
 from .heartbeat import Deathwatch, LivenessPolicy  # noqa: F401
 from .supervisor import RetryPolicy, RunReport, Supervisor  # noqa: F401

@@ -14,16 +14,30 @@ fault goes through the real ``PreemptionGuard``). ``--verify-parity``
 final params are BITWISE equal — recovery that changed the trajectory is a
 failure, not a recovery.
 
-``--elastic`` (ISSUE 11) arms the Supervisor's mesh re-planner: the
-default schedule kills a replica mid-epoch (``replica_death@step=3``),
-the run re-plans to the largest feasible world <= survivors, reshards the
-checkpoint (flat-padded re-slice + EF row fold — resilience/elastic.py),
-and CONTINUES at the shrunken size. The parity control then becomes the
-post-resize one: restore the SAME resize-point checkpoint independently,
-reshard it the same way, run the remaining steps clean at the new world —
-the post-resize segment must be BITWISE equal. ``--layout
+``--elastic`` (ISSUEs 11 + 12) arms the Supervisor's mesh re-planner AND
+the capacity watch: the default schedule kills a replica mid-epoch
+(``replica_death@step=3`` — the run re-plans to the largest feasible
+world <= survivors, reshards the checkpoint, continues at the shrunken
+size) and then RETURNS the capacity (``capacity_return@step=4`` — the
+supervisor grows back to the full world at the next segment boundary:
+drain, checkpoint, re-plan UP, live reshard). Elasticity is proven
+BIDIRECTIONAL in one run: 8 -> 4 -> 8. The parity control is the
+post-LAST-resize one: restore the SAME resize-anchor checkpoint
+independently (probing the manifest's OWN recorded world), reshard it
+through the same helpers, run the remaining steps clean at the final
+world — the post-resize segment must be BITWISE equal. ``--layout
 {replicated,zero1,fsdp}`` and ``--wire-dtype`` pick the state layout the
-resize must re-slice (int8 wires include the EF residuals).
+resize must re-slice (int8 wires include the EF residuals, whose rows
+fold M -> N zero-extended on a grow — the telescoping total is
+preserved).
+
+``fleet`` (ISSUE 12) is the cross-PROCESS story: an external orchestrator
+(resilience/fleet.py) launches train.py children, watches exit codes,
+and relaunches with a DIFFERENT world size over the shared checkpoint
+directory — kill -> relaunch at half world -> capacity return -> relaunch
+at full world, with cross-world restores riding train.py's elastic
+--resume (raw restore + reshard; never a CheckpointWorldSizeMismatch
+escape) and a control child verifying the final segment bitwise.
 
 Exit codes: 0 recovered (and parity held), 1 not.
 """
@@ -174,28 +188,60 @@ def _elastic_control(args, ckpt_dir: str, report, rig_for):
     return control
 
 
+def _add_fleet_args(p: argparse.ArgumentParser) -> None:
+    """The `resilience fleet` scenario's own knobs (resilience/fleet.py);
+    chaos ignores them. The shared knobs — --ckpt-dir, --seed, --layout,
+    --wire-dtype, --epochs, --json, --no-verify-parity — apply to both
+    commands."""
+    p.add_argument("--global-batch", type=int, default=16,
+                   help="fleet: the FIXED global batch every generation "
+                        "splits over its world (the elastic invariant)")
+    p.add_argument("--synthetic-size", type=int, default=64,
+                   help="fleet: synthetic dataset rows (steps/epoch = "
+                        "rows / global batch)")
+    p.add_argument("--capacity", default="8,4,8",
+                   help="fleet: available replicas per launch generation, "
+                        "comma-separated (last value repeats) — the "
+                        "scripted capacity feed: 8,4,8 is kill -> "
+                        "half-world relaunch -> capacity-return relaunch")
+    p.add_argument("--gen-chaos", default=None,
+                   help="fleet: per-generation chaos specs "
+                        "'GEN:SPEC[;GEN:SPEC...]' (default: generation 0 "
+                        "crashes mid-epoch-1, generation 1 drains on "
+                        "SIGTERM shortly before the end)")
+    p.add_argument("--max-launches", type=int, default=8,
+                   help="fleet: launch budget before giving up")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="resilience", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("command", choices=["chaos"],
-                   help="'chaos' runs the scripted fault schedule")
+    p.add_argument("command", choices=["chaos", "fleet"],
+                   help="'chaos' runs the scripted in-process fault "
+                        "schedule; 'fleet' runs the cross-process "
+                        "relaunch scenario (resilience/fleet.py)")
     p.add_argument("--chaos", default=None,
                    help="fault plan (resilience/faults.py spec; default: "
-                        "the full fixed-world schedule, or "
-                        "replica_death@step=3 with --elastic)")
+                        "the full fixed-world schedule, or the "
+                        "shrink-then-grow replica_death@step=3,"
+                        "capacity_return@step=4 with --elastic)")
     p.add_argument("--elastic", action="store_true",
-                   help="arm the Supervisor's mesh re-planner: a "
-                        "replica_death fault restarts the run resharded "
-                        "to the surviving replica count, and the parity "
-                        "control verifies the post-resize segment bitwise")
+                   help="arm the Supervisor's mesh re-planner + capacity "
+                        "watch: a replica_death fault restarts the run "
+                        "resharded to the surviving replica count, a "
+                        "capacity_return fault grows it back at the next "
+                        "segment boundary, and the parity control "
+                        "verifies the post-resize segment bitwise")
     p.add_argument("--layout", default="replicated",
                    choices=["replicated", "zero1", "fsdp"],
                    help="state layout the run (and any reshard) exercises")
     p.add_argument("--wire-dtype", default="fp32",
                    help="gradient wire dtype (int8 wires add EF residuals "
                         "to the resharded state)")
-    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=None,
+                   help="training epochs (default: 2 for chaos; 3 for "
+                        "fleet — one epoch per world phase)")
     p.add_argument("--per-device-batch", type=int, default=2)
     p.add_argument("--dataset-size", type=int, default=64)
     p.add_argument("--checkpoint-every-steps", type=int, default=2)
@@ -207,9 +253,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="skip the no-fault same-seed control run")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable one-line report on stdout")
+    _add_fleet_args(p)
     args = p.parse_args(argv)
+    if args.command == "fleet":
+        if args.epochs is None:
+            args.epochs = 3
+        from .fleet import fleet_main
+        return fleet_main(args)
+    if args.epochs is None:
+        args.epochs = 2
     if args.chaos is None:
-        args.chaos = ("replica_death@step=3" if args.elastic else
+        # the default elastic schedule is BIDIRECTIONAL (ISSUE 12): kill
+        # a replica at step 3 (8 -> 4 at the restart), return the
+        # capacity at the step-4 fence (4 -> 8 at the next segment
+        # boundary) — one run proves shrink, grow, and the EF fold both
+        # ways
+        args.chaos = ("replica_death@step=3,capacity_return@step=4"
+                      if args.elastic else
                       "crash@step=3,torn_ckpt@save=2,"
                       "crash_during_save@save=2,sigterm@step=6")
 
@@ -228,8 +288,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .supervisor import RetryPolicy, Supervisor, SupervisorError
 
     mesh = build_mesh(MeshSpec(), devices=jax.devices())
-    injector = FaultInjector(FaultPlan.parse(args.chaos))
     world0 = len(jax.devices())
+    # the capacity registry (elastic runs): replica deaths debit it via
+    # the Supervisor, the capacity_return fault credits it via the
+    # injector, and the Supervisor's segment-boundary poll grows on it
+    capacity = None
+    if args.elastic:
+        from .capacity import CapacityWatch
+        capacity = CapacityWatch(total=world0)
+    injector = FaultInjector(FaultPlan.parse(args.chaos),
+                             capacity_watch=capacity)
     global_batch = args.per_device_batch * world0
     # one rig per world this run has trained at — the replan builds them
     # lazily over device SUBSETS (the in-process stand-in for a relaunch
@@ -299,7 +367,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sup = Supervisor(trainer, ckpt, state_factory, loader, retry=retry,
                      guard=guard, injector=injector,
                      checkpoint_every_steps=args.checkpoint_every_steps,
-                     resume_preempted=True, replan_cb=replan_cb)
+                     resume_preempted=True, replan_cb=replan_cb,
+                     capacity_watch=capacity)
     error = None
     try:
         state, report = sup.run(args.epochs)
@@ -354,11 +423,21 @@ def main(argv: Optional[List[str]] = None) -> int:
              **report.as_dict()}
     # flights_ok is part of RECOVERED: a fault that left no postmortem
     # artifact would make the next real incident undiagnosable; an elastic
-    # run that never resized (the schedule missed) proved nothing
+    # run that never resized (the schedule missed) proved nothing — and a
+    # schedule whose capacity RETURNED but whose run never grew proved
+    # only half of bidirectional elasticity
+    grew = any(r.get("direction") == "grow"
+               for r in report.resizes)
+    capacity_returned = any(label.startswith("capacity_return")
+                            for label in report.faults_fired)
+    # the grow requirement binds only under --elastic: without a watch a
+    # capacity_return fault fires into the void by design (faults.py) —
+    # a fixed-world run that recovered must not be scored FAILED for it
     ok = (report.completed and report.fence_violations == 0
           and parity is not False and error is None
           and flight_stats["flights_ok"]
-          and (not args.elastic or bool(report.resizes)))
+          and (not args.elastic or bool(report.resizes))
+          and (not args.elastic or not capacity_returned or grew))
     if args.as_json:
         print(json.dumps(stats, sort_keys=True))
     else:
@@ -368,8 +447,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{k}: {stats[k]}")
         print(f"faults fired: {stats['faults_fired']}")
         for r in stats.get("resizes", []):
-            print(f"elastic resize: {r['from_world']} -> {r['to_world']} "
-                  f"replicas (survivors={r['survivors']}, restored label "
+            print(f"elastic {r.get('direction', 'resize')}: "
+                  f"{r['from_world']} -> {r['to_world']} replicas "
+                  f"(available={r['survivors']}, anchor label "
                   f"{r['label']}, resumed epoch {r['epoch']} "
                   f"step {r['step']})")
         print(f"flight artifacts: {len(stats['flights'])} "

@@ -1,0 +1,121 @@
+"""Capacity watcher: the grow-side analog of the Deathwatch (ISSUE 12).
+
+The Deathwatch (heartbeat.py) notices capacity LEAVING — a dead relay, a
+lost replica — and turns it into a prompt, recoverable exit. Nothing in
+the stack noticed capacity COMING BACK: a run that shrank 8 -> 4 after a
+preemption stayed shrunk forever, paying double per-device batch (and the
+matching step-time) long after the preempted chips returned. The
+:class:`CapacityWatch` closes that half:
+
+* it is a REGISTRY — ``total`` replicas exist in the fleet, ``available``
+  of them are currently usable. Replica deaths call :meth:`lose`,
+  capacity returns call :meth:`restore` (the chaos injector's
+  ``capacity_return@step=k`` fault drives it deterministically; a real
+  deployment points ``probe`` at its device/cluster feed);
+* it is POLLED, never raced: the Supervisor asks :meth:`poll_grow` at
+  SEGMENT BOUNDARIES only — after the segment drained and its checkpoint
+  was written — so a grow is always anchored at a durable, labeled
+  coordinate (the same discipline as the preemption drain). A mid-step
+  capacity blip can never tear a step;
+* growing is a RE-PLAN, not a guess: the Supervisor hands the available
+  count to its ``replan_cb``, which picks the largest feasible world
+  ``<= available`` dividing the FIXED global batch
+  (:func:`.elastic.plan_elastic_world`) — capacity that returns in a
+  quantity no feasible world can use (5 survivors, batch 16) changes
+  nothing.
+
+Thread-safe: the injector's step fence (main thread), a probe thread, and
+the Supervisor's boundary poll may all touch the counts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..telemetry import recorder as _telemetry
+
+
+class CapacityWatch:
+    """Pollable fleet-capacity registry.
+
+    ``total`` is the full fleet size (replicas). ``available`` starts at
+    ``total`` unless given. ``probe`` (optional) is a zero-arg callable
+    returning the CURRENT available count from an external source — when
+    set, it is consulted (and the internal count synced to it) on every
+    :meth:`available` read; ``lose``/``restore`` still work as manual
+    overrides between probes (the chaos harness path).
+    """
+
+    def __init__(self, total: int, available: Optional[int] = None,
+                 probe: Optional[Callable[[], int]] = None):
+        if total < 1:
+            raise ValueError(f"a fleet needs >= 1 replica, got {total}")
+        self.total = int(total)
+        self._available = int(total if available is None else available)
+        if not 0 <= self._available <= self.total:
+            raise ValueError(
+                f"available ({self._available}) must lie in "
+                f"[0, total={self.total}]")
+        self._probe = probe
+        self._lock = threading.Lock()
+        # set whenever capacity INCREASES (restore / a probe reading above
+        # the last one) — a cheap "worth polling" hint for callers that
+        # want to wait instead of poll; cleared by poll_grow
+        self.returned = threading.Event()
+
+    def available(self) -> int:
+        """Current available replica count (probe-synced when armed)."""
+        with self._lock:
+            if self._probe is not None:
+                fresh = int(self._probe())
+                fresh = max(0, min(fresh, self.total))
+                if fresh > self._available:
+                    self.returned.set()
+                self._available = fresh
+            return self._available
+
+    def lose(self, n: int = 1) -> int:
+        """``n`` replicas left the fleet (a replica death); returns the
+        new available count (never below 0)."""
+        with self._lock:
+            self._available = max(0, self._available - int(n))
+            return self._available
+
+    def sync(self, available: int) -> int:
+        """Set the available count ABSOLUTELY (clamped to [0, total]) —
+        the Supervisor's death-restart bookkeeping: a replica death
+        re-plans over the SURVIVING ACTIVE replicas (``old_world - 1``),
+        and the registry must agree with that decision or the next
+        boundary poll would see phantom idle capacity and grow right back
+        mid-incident. Capacity genuinely returning is :meth:`restore`
+        (the ``capacity_return`` fault / a probe reading)."""
+        with self._lock:
+            self._available = max(0, min(int(available), self.total))
+            return self._available
+
+    def restore(self, n: Optional[int] = None) -> int:
+        """``n`` replicas came back (``None`` = all of them: available
+        returns to ``total``); returns the new available count."""
+        with self._lock:
+            if n is None:
+                self._available = self.total
+            else:
+                self._available = min(self.total,
+                                      self._available + int(n))
+            self.returned.set()
+            return self._available
+
+    def poll_grow(self, current_world: Optional[int]) -> Optional[int]:
+        """The Supervisor's segment-boundary poll: the available count
+        when it EXCEEDS ``current_world`` (a grow may be feasible — the
+        replan decides whether a larger world actually divides the global
+        batch), else None. Emits a ``capacity_watch`` telemetry span so
+        the summary's step-time split accounts the polling, and clears
+        :attr:`returned`."""
+        with _telemetry.span("capacity_watch", world=current_world):
+            avail = self.available()
+            self.returned.clear()
+            if current_world is None or avail <= current_world:
+                return None
+            return avail

@@ -26,10 +26,15 @@ a RE-SLICE, not a gather:
   layer group at a time: peak host memory is one leaf beyond the state
   itself.
 
-The Supervisor drives this through ``replan_cb`` (supervisor.py); the
+The Supervisor drives this through ``replan_cb`` (supervisor.py) — on a
+``replica_death`` restart (shrink, restore-then-reshard) AND at a
+capacity-return segment boundary (grow, live-state reshard M -> N with
+zero-extended shards/EF rows, ISSUE 12); :func:`reshard_raw_state` is
+the cross-PROCESS arm (a fleet relaunch reshards a template-free raw
+restore, resilience/fleet.py + train.py's elastic ``--resume``). The
 ``resilience chaos --elastic`` harness proves the post-resize segment
-bitwise-equal to a clean same-seed continuation at the shrunken world
-(PARITY.md "Exactness model: elastic reshard").
+bitwise-equal to a clean same-seed continuation at the new world in
+both directions (PARITY.md "Exactness model: elastic reshard").
 """
 
 from __future__ import annotations
@@ -175,6 +180,56 @@ def _reshard_grad_sync(old_gs, template_gs, trainer, old_n: int,
 
     return {"ef": jax.tree_util.tree_map(one, old_gs["ef"],
                                          template_gs["ef"])}
+
+
+def reshard_raw_state(arrays: dict, old_n: int, new_n: int, trainer,
+                      template) -> Any:
+    """Cross-PROCESS elastic restore (ISSUE 12): reshard the RAW host
+    arrays of a checkpoint — ``training.checkpoint.CheckpointManager.
+    restore_latest_raw``'s output, saved at ``old_n`` — into the current
+    run's ``new_n`` layout.
+
+    A relaunched process at a different world size cannot build the old
+    world's device templates (that mesh no longer exists here), so the
+    checkpoint's own saved shapes ARE the old-world template: the raw
+    nested containers are re-treed onto the current template's pytree
+    structure positionally (orbax flattens the same TrainState both
+    sides, so leaf order matches — checked by leaf count, and every leaf
+    then passes the reshard's own shape dispatch), wrapped into a
+    pseudo-state, and run through :func:`reshard_train_state`. A
+    checkpoint written before EF residuals existed restores with the
+    template's zero residuals — error feedback restarts its telescope,
+    exactly as the fixed-template restore path does."""
+    import jax
+
+    def retree(name: str, tmpl_sub):
+        raw_sub = arrays[name]
+        leaves = jax.tree_util.tree_leaves(raw_sub)
+        treedef = jax.tree_util.tree_structure(tmpl_sub)
+        if len(leaves) != treedef.num_leaves:
+            raise ValueError(
+                f"checkpoint subtree {name!r} holds {len(leaves)} "
+                f"array(s) but this run's template expects "
+                f"{treedef.num_leaves} — the relaunch changed the model/"
+                "optimizer/wire configuration, not just the world size "
+                "(an elastic relaunch must keep the training config)")
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    raw_gs = arrays.get("grad_sync")
+    pseudo = template.replace(
+        step=np.asarray(arrays["step"]),
+        params=retree("params", template.params),
+        opt_state=retree("opt_state", template.opt_state),
+        batch_stats=retree("batch_stats", template.batch_stats),
+        grad_sync=(retree("grad_sync", template.grad_sync)
+                   if raw_gs is not None else {}))
+    if raw_gs is None:
+        # pre-EF checkpoint into an EF template: reshard everything else,
+        # keep the template's zero residuals (a fresh telescope start)
+        out = reshard_train_state(pseudo, old_n, new_n, trainer,
+                                  template.replace(grad_sync={}))
+        return out.replace(grad_sync=template.grad_sync)
+    return reshard_train_state(pseudo, old_n, new_n, trainer, template)
 
 
 def reshard_train_state(state, old_n: int, new_n: int, trainer,

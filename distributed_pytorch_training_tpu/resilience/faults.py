@@ -28,6 +28,14 @@ points, so every failure a test provokes is reproducible:
   ELASTIC restart — the mesh re-plans to the surviving replica count and
   the checkpoint reshards (resilience/elastic.py); without one it is an
   ordinary restartable crash.
+* ``capacity_return@step=7`` — preempted capacity RETURNS at the step-7
+  fence: the injector notifies its armed
+  :class:`~.capacity.CapacityWatch` (``restore()`` — back to the full
+  registry). Nothing raises: a Supervisor polling the watch grows the
+  mesh at the NEXT segment boundary (drain → checkpoint → re-plan UP →
+  reshard), so the grow is anchored at a durable coordinate exactly like
+  the preemption drain. Without a watch the fault fires into the void
+  (logged) — the schedule stays reproducible either way.
 
 Any spec may carry a repeat count: ``replica_death@step=3x2`` fires TWICE
 (the restart's replay re-crosses the step-3 fence and the second firing
@@ -72,6 +80,7 @@ FAULT_KINDS = {
     "torn_ckpt": "save",
     "crash_during_save": "save",
     "replica_death": "step",
+    "capacity_return": "step",
 }
 
 # Repeat counts (`kind@trigger=N xK`, e.g. "replica_death@step=3x2"): the
@@ -206,9 +215,14 @@ class FaultInjector:
     finalizes. All are cheap membership checks when nothing matches."""
 
     def __init__(self, plan: FaultPlan,
-                 log: Callable[[str], None] = _stderr_log):
+                 log: Callable[[str], None] = _stderr_log,
+                 capacity_watch=None):
         self.plan = plan
         self.log = log
+        # the grow-side registry a capacity_return fault notifies
+        # (resilience/capacity.CapacityWatch, or None: the fault fires
+        # into the void — logged, recorded in `fired`, changing nothing)
+        self.capacity_watch = capacity_watch
         # [fault, remaining firings] — `remaining` starts at the parsed
         # repeat count (1 without an xK suffix) and the fault leaves the
         # pending list only once spent
@@ -241,6 +255,18 @@ class FaultInjector:
 
     def on_step(self, step: int) -> None:
         """Step fence, called BEFORE global step ``step`` executes."""
+        if self._take("capacity_return", step) is not None:
+            # checked before the raising kinds: capacity returning at the
+            # same fence a crash fires on must still be registered (the
+            # post-restart boundary poll then sees it)
+            if self.capacity_watch is not None:
+                avail = self.capacity_watch.restore()
+                self.log(f"chaos: capacity returned at step {step} "
+                         f"({avail}/{self.capacity_watch.total} replicas "
+                         "available)")
+            else:
+                self.log(f"chaos: capacity returned at step {step} "
+                         "(no CapacityWatch armed — nothing to notify)")
         if self._take("sigterm", step) is not None:
             self.log(f"chaos: delivering SIGTERM at step {step}")
             os.kill(os.getpid(), signal.SIGTERM)

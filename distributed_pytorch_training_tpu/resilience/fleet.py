@@ -1,0 +1,564 @@
+"""Cross-process fleet orchestrator (ISSUE 12): relaunch ``train.py``
+children at whatever world size the fleet actually has.
+
+The in-process elastic path (supervisor.py + elastic.py) resizes over
+surviving LOCAL devices — but a real preemptible fleet loses whole
+processes/hosts, and the relaunch comes back with a *different process
+count*, not a shrunken in-process mesh. This module is the external half:
+
+* **launch** a training child per *generation* (``argv_for`` builds the
+  command; the launch generation + rank ride the env —
+  ``DPT_FLEET_GENERATION`` / ``DPT_FLEET_RANK`` — and every flight the
+  child flushes carries them in its cause, telemetry/flight.py);
+* **watch the exit code**: rc=0 with the target step reached is
+  completion; rc=0 short of it is a drained preemption (train.py's
+  SIGTERM drain checkpoints and exits clean); rc=70 is the Deathwatch
+  contract (heartbeat.py); anything else is a crash. Progress is probed
+  from the checkpoint directory's integrity MANIFESTS alone
+  (:func:`checkpoint_progress`) — the orchestrator is jax/orbax-free by
+  design, it must never initialize a backend;
+* **relaunch at the capacity the fleet has**: each generation asks the
+  capacity feed (scripted in the harness; a cluster API in production)
+  and plans the largest feasible world ``<= available`` dividing the
+  fixed global batch (:func:`.elastic.plan_elastic_world`) — the child is
+  launched with that many devices and ``--mesh data=<world>``, resuming
+  over the SHARED checkpoint directory. Cross-world restores ride
+  train.py's elastic ``--resume`` (raw restore + reshard;
+  ``CheckpointWorldSizeMismatch`` never escapes a relaunch — the
+  orchestrator scans child logs and counts any escape as a hard error).
+
+``resilience fleet`` (:func:`fleet_main`) runs the canonical CPU-mesh
+scenario end to end: kill at full world → relaunch at half world →
+capacity returns → relaunch at full world, then verifies one flight per
+abnormal child exit and (``--verify-parity``) that the final segment is
+bitwise-equal to an uninterrupted control child continuing from the last
+relaunch point.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..telemetry.flight import FLEET_GENERATION_ENV, FLEET_RANK_ENV
+from .elastic import plan_elastic_world
+from .heartbeat import DEATHWATCH_EXIT_CODE
+
+# FLEET_GENERATION_ENV / FLEET_RANK_ENV are telemetry/flight.py's (one
+# definition: the reader of the stamp owns the names) — re-exported here
+# because the orchestrator is the writer.
+__all__ = ["FLEET_GENERATION_ENV", "FLEET_RANK_ENV", "FleetOrchestrator",
+           "FleetLaunch", "FleetReport", "checkpoint_progress",
+           "check_fleet_flights", "fleet_main"]
+
+_DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _stderr_log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _xla_flags_for(world: int, base: str = "") -> str:
+    """``base`` XLA flags with the host-platform device count replaced by
+    ``world`` — the CPU-mesh stand-in for launching a child on a fleet of
+    ``world`` chips (any inherited count, e.g. the test harness's 8, must
+    not leak into a half-world child)."""
+    kept = [f for f in (base or "").split()
+            if not f.startswith(_DEVICE_COUNT_FLAG)]
+    kept.append(f"{_DEVICE_COUNT_FLAG}={world}")
+    return " ".join(kept)
+
+
+def checkpoint_progress(ckpt_dir) -> Tuple[int, Optional[int]]:
+    """``(step, world_size)`` of the newest FINALIZED checkpoint, read
+    from the integrity manifests alone (``.manifests/<label>.json``,
+    training/checkpoint.py) — no jax, no orbax, no backend. A label whose
+    ``.pending`` marker survives without a manifest never finalized and
+    does not count. ``(-1, None)`` when nothing is finalized."""
+    mdir = Path(ckpt_dir) / ".manifests"
+    best_label, best = -1, (-1, None)
+    if not mdir.is_dir():
+        return best
+    for p in mdir.glob("*.json"):
+        try:
+            label = int(p.stem)
+            body = json.loads(p.read_text())
+            step = int(body.get("step", -1))
+        except (ValueError, OSError):
+            continue  # torn/foreign manifest: not progress
+        if label > best_label:
+            best_label = label
+            world = body.get("world_size")
+            best = (step, int(world) if world is not None else None)
+    return best
+
+
+@dataclasses.dataclass
+class FleetLaunch:
+    """One child launch: what ran, how it exited, what progress it left."""
+
+    generation: int
+    world: int
+    available: int
+    resume: bool
+    argv: List[str] = dataclasses.field(default_factory=list)
+    rc: Optional[int] = None
+    seconds: float = 0.0
+    outcome: str = "launched"   # completed | drained | crashed | relay_death
+    step_after: int = -1
+    log_path: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FleetReport:
+    """The orchestrator's verdict (the ``resilience fleet`` JSON body)."""
+
+    target_step: int = -1
+    completed: bool = False
+    relaunches: int = 0
+    final_step: int = -1
+    final_world: Optional[int] = None
+    mismatch_escapes: int = 0   # CheckpointWorldSizeMismatch in child logs
+    launches: List[dict] = dataclasses.field(default_factory=list)
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FleetOrchestrator:
+    """Launch-watch-relaunch over a shared checkpoint directory.
+
+    ``argv_for(world, generation, resume)`` builds one child's command
+    line (the CLI builds a train.py invocation; tests use stub scripts).
+    ``capacity_for`` is the capacity feed: a callable ``generation ->
+    available replicas``, or a sequence whose last value repeats — the
+    scripted stand-in for a cluster's capacity API. ``global_batch`` is
+    FIXED across generations (the elastic invariant: per-device batch
+    changes, the trajectory doesn't). ``target_step`` decides completion:
+    a child exiting rc=0 short of it was drained (preempted), not done.
+    ``on_child_exit(generation, launch)`` fires after every child exit —
+    the CLI snapshots the checkpoint directory there for the parity
+    control. ``set_child_devices=True`` pins each child to a CPU mesh of
+    exactly ``world`` virtual devices (JAX_PLATFORMS=cpu + XLA_FLAGS);
+    pass False when ``argv_for`` manages the child environment itself.
+    """
+
+    def __init__(self, argv_for: Callable[..., List[str]], ckpt_dir,
+                 *, global_batch: int, target_step: int,
+                 capacity_for: Union[Callable[[int], int], Sequence[int]],
+                 max_launches: int = 8,
+                 env_extra: Optional[Dict[str, str]] = None,
+                 set_child_devices: bool = True,
+                 on_child_exit: Optional[Callable[..., None]] = None,
+                 log_dir=None,
+                 log: Callable[[str], None] = _stderr_log):
+        if max_launches < 1:
+            raise ValueError(f"max_launches must be >= 1, "
+                             f"got {max_launches}")
+        self.argv_for = argv_for
+        self.ckpt_dir = Path(ckpt_dir)
+        self.global_batch = int(global_batch)
+        self.target_step = int(target_step)
+        self._capacity = (capacity_for if callable(capacity_for)
+                          else self._sequence_feed(capacity_for))
+        self.max_launches = int(max_launches)
+        self.env_extra = dict(env_extra or {})
+        self.set_child_devices = set_child_devices
+        self.on_child_exit = on_child_exit
+        self.log_dir = Path(log_dir) if log_dir is not None \
+            else self.ckpt_dir / "fleet_logs"
+        self.log = log
+
+    @staticmethod
+    def _sequence_feed(seq: Sequence[int]) -> Callable[[int], int]:
+        values = [int(v) for v in seq]
+        if not values:
+            raise ValueError("capacity sequence is empty")
+
+        def feed(generation: int) -> int:
+            return values[min(generation, len(values) - 1)]
+
+        return feed
+
+    def _child_env(self, world: int, generation: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.env_extra)
+        env[FLEET_GENERATION_ENV] = str(generation)
+        env[FLEET_RANK_ENV] = "0"
+        if self.set_child_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = _xla_flags_for(world,
+                                              env.get("XLA_FLAGS", ""))
+        return env
+
+    def _outcome(self, rc: int, step_after: int) -> str:
+        if rc == 0:
+            return ("completed" if step_after >= self.target_step
+                    else "drained")
+        if rc == DEATHWATCH_EXIT_CODE:
+            return "relay_death"
+        return "crashed"
+
+    def run(self) -> FleetReport:
+        report = FleetReport(target_step=self.target_step)
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        for generation in range(self.max_launches):
+            available = int(self._capacity(generation))
+            world = plan_elastic_world(available, self.global_batch)
+            step_before, _ = checkpoint_progress(self.ckpt_dir)
+            resume = step_before >= 0
+            argv = self.argv_for(world=world, generation=generation,
+                                 resume=resume)
+            launch = FleetLaunch(generation=generation, world=world,
+                                 available=available, resume=resume,
+                                 argv=list(argv))
+            log_path = self.log_dir / f"gen{generation}.log"
+            launch.log_path = str(log_path)
+            self.log(f"fleet: generation {generation} — launching world "
+                     f"{world} ({available} available"
+                     + (", --resume" if resume else ", fresh") + ")")
+            t0 = time.perf_counter()
+            with open(log_path, "wb") as lf:
+                proc = subprocess.run(
+                    argv, env=self._child_env(world, generation),
+                    stdout=lf, stderr=subprocess.STDOUT)
+            launch.rc = proc.returncode
+            launch.seconds = round(time.perf_counter() - t0, 3)
+            step_after, world_after = checkpoint_progress(self.ckpt_dir)
+            launch.step_after = step_after
+            launch.outcome = self._outcome(launch.rc, step_after)
+            try:
+                text = log_path.read_text(errors="replace")
+            except OSError:
+                text = ""
+            if "CheckpointWorldSizeMismatch" in text:
+                # the acceptance gate: every cross-world restore must ride
+                # the elastic resume path — a named mismatch reaching a
+                # child's output means a relaunch DIED on (or even just
+                # warned about) the exact failure this orchestrator exists
+                # to absorb
+                report.mismatch_escapes += 1
+                report.errors.append(
+                    f"generation {generation}: CheckpointWorldSizeMismatch"
+                    " escaped into the child log")
+            self.log(f"fleet: generation {generation} exited rc="
+                     f"{launch.rc} after {launch.seconds:.1f}s — "
+                     f"{launch.outcome} (checkpoint step {step_after}/"
+                     f"{self.target_step})")
+            report.launches.append(launch.as_dict())
+            report.final_step = step_after
+            report.final_world = world_after
+            if self.on_child_exit is not None:
+                self.on_child_exit(generation, launch)
+            if launch.outcome == "completed":
+                report.completed = True
+                break
+        report.relaunches = max(0, len(report.launches) - 1)
+        if not report.completed:
+            report.errors.append(
+                f"fleet did not reach step {self.target_step} within "
+                f"{self.max_launches} launch(es)")
+        return report
+
+
+# ---------------------------------------------------------------------------
+# the `resilience fleet` CLI scenario: train.py children on the CPU mesh
+# ---------------------------------------------------------------------------
+
+
+def _repo_train_py() -> Path:
+    path = Path(__file__).resolve().parents[2] / "train.py"
+    if not path.is_file():
+        raise FileNotFoundError(
+            f"train.py not found at {path} — `resilience fleet` drives "
+            "the repo checkout's training entry point")
+    return path
+
+
+def _train_argv(args, world: int, resume: bool, chaos: Optional[str],
+                ckpt_dir: str, out_dir: str) -> List[str]:
+    """One train.py child: the tiny synthetic-CIFAR ResNet workload
+    (augmentation off, fp32 — bitwise parity is the acceptance bar),
+    sized so per-device batch = global_batch / world at every world."""
+    if args.global_batch % world:
+        raise ValueError(f"global batch {args.global_batch} does not "
+                         f"divide over world {world}")
+    argv = [sys.executable, str(_repo_train_py()),
+            "--model", "resnet18",
+            "--model-overrides", "num_filters=4",
+            "--cifar-stem", "--no-augment",
+            "--dataset", "cifar10", "--synthetic",
+            "--synthetic-size", str(args.synthetic_size),
+            "--epochs", str(args.epochs),
+            "--batch-size", str(args.global_batch // world),
+            "--mesh", f"data={world}",
+            "--seed", str(args.seed),
+            "--lr", "0.05",
+            "--print-freq", "1000",
+            "--checkpoint-dir", ckpt_dir,
+            "--checkpoint-every", "1",
+            "--output-dir", out_dir]
+    if args.layout == "zero1":
+        argv.append("--zero1")
+    elif args.layout == "fsdp":
+        argv.append("--fsdp-explicit")
+    if args.wire_dtype != "fp32":
+        argv += ["--wire-dtype", args.wire_dtype]
+    if resume:
+        argv.append("--resume")
+    if chaos:
+        argv += ["--chaos", chaos]
+    return argv
+
+
+def _parse_gen_chaos(spec: Optional[str], spe: int,
+                     target_step: int) -> Dict[int, str]:
+    """``"0:crash@step=6;1:sigterm@step=10"`` -> {0: ..., 1: ...}.
+    Default: the canonical kill -> drain schedule — generation 0 crashes
+    mid-epoch-1 (after one epoch checkpoint exists), generation 1 drains
+    on SIGTERM two steps short of the end (a mid-epoch preemption save
+    the full-world relaunch must resume from)."""
+    if spec is None:
+        crash_at = spe + max(1, spe // 2)
+        drain_at = max(crash_at + 1, target_step - spe + 1)
+        return {0: f"crash@step={crash_at}",
+                1: f"sigterm@step={drain_at}"}
+    out: Dict[int, str] = {}
+    for item in filter(None, (s.strip() for s in spec.split(";"))):
+        gen_s, _, chaos = item.partition(":")
+        if not chaos:
+            raise ValueError(f"--gen-chaos item {item!r} is not "
+                             "GEN:SPEC")
+        out[int(gen_s)] = chaos
+    return out
+
+
+def _compare_final_checkpoints(real_dir: str, control_dir: str,
+                               log=_stderr_log) -> Optional[bool]:
+    """Bitwise comparison of the newest valid checkpoint in two
+    directories, RAW (saved shapes; no template, no mesh — works at any
+    world) and over the WHOLE saved state: params, optimizer moments,
+    batch stats, EF residuals, step counters. Params alone would let a
+    reshard bug that corrupts only the moments or residual rows (which
+    never reaches a loss before the final save) score as parity. None
+    when either side has nothing to compare."""
+    import numpy as np
+
+    from ..training.checkpoint import CheckpointManager
+
+    def load(d):
+        mgr = CheckpointManager(d)
+        try:
+            return mgr.restore_latest_raw()
+        finally:
+            mgr.close()
+
+    real, control = load(real_dir), load(control_dir)
+    if real is None or control is None:
+        return None
+    real_arrays, real_label, real_world, *_ = real
+    ctl_arrays, ctl_label, ctl_world, *_ = control
+    if real_label != ctl_label or real_world != ctl_world \
+            or sorted(real_arrays) != sorted(ctl_arrays):
+        log(f"fleet: parity control diverged structurally — real "
+            f"label/world {real_label}/{real_world} vs control "
+            f"{ctl_label}/{ctl_world}")
+        return False
+    import jax.tree_util as jtu
+
+    for key in sorted(real_arrays):
+        real_leaves = jtu.tree_leaves(real_arrays[key])
+        ctl_leaves = jtu.tree_leaves(ctl_arrays[key])
+        if len(real_leaves) != len(ctl_leaves) or not all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(real_leaves, ctl_leaves)):
+            log(f"fleet: parity mismatch in checkpoint subtree {key!r}")
+            return False
+    return True
+
+
+def check_fleet_flights(flight_dir, launches: List[dict],
+                        ignore=None) -> dict:
+    """One flight per ABNORMAL child exit, attributable by generation:
+    a crashed/relay-death child must leave exactly one flight stamped
+    ``[fleet gen=G ...]`` whose cause matches a crash; a drained child
+    exactly one whose cause names the preemption. A completed child must
+    leave none. ``ignore`` holds flight paths that existed BEFORE this
+    fleet ran: a reused ``--ckpt-dir`` must not let a previous run's
+    postmortems satisfy — or fail — THIS run's accounting (the same
+    guard the chaos harness applies)."""
+    flights = []
+    for p in sorted(Path(flight_dir).glob("flight_*.json")):
+        if ignore and p in ignore:
+            continue
+        try:
+            body = json.loads(p.read_text())
+            flights.append({"path": str(p),
+                            "cause": body.get("cause", ""),
+                            "generation": body.get("fleet_generation")})
+        except ValueError:
+            flights.append({"path": str(p), "cause": None,
+                            "generation": None})
+    problems = []
+    for launch in launches:
+        gen = str(launch["generation"])
+        mine = [f for f in flights if f["generation"] == gen]
+        outcome = launch["outcome"]
+        if outcome in ("crashed", "relay_death", "drained"):
+            if len(mine) != 1:
+                problems.append(
+                    f"generation {gen} ({outcome}) left {len(mine)} "
+                    "flight(s), expected exactly 1")
+            elif outcome == "drained" \
+                    and "preemption" not in (mine[0]["cause"] or ""):
+                problems.append(
+                    f"generation {gen} drained but its flight cause "
+                    f"is {mine[0]['cause']!r}, not a preemption")
+        elif outcome == "completed" and mine:
+            problems.append(
+                f"generation {gen} completed but left "
+                f"{len(mine)} flight(s)")
+    ok = not problems and all(f["cause"] is not None for f in flights)
+    return {"flights": flights, "flight_problems": problems,
+            "flights_ok": ok}
+
+
+def fleet_main(args) -> int:
+    """The ``resilience fleet`` scenario. Exit 0 iff the fleet completed,
+    every abnormal child exit left exactly one attributable flight, no
+    ``CheckpointWorldSizeMismatch`` escaped, and (unless
+    ``--no-verify-parity``) the final checkpoint is bitwise-equal to an
+    uninterrupted control child continuing from the last relaunch
+    point."""
+    base = Path(args.ckpt_dir or tempfile.mkdtemp(prefix="dpt-fleet-"))
+    base.mkdir(parents=True, exist_ok=True)
+    ckpt_dir = base / "ckpt"
+    out_dir = base / "out"       # children's flights + telemetry
+    spe, leftover = divmod(args.synthetic_size, args.global_batch)
+    if leftover or spe < 2:
+        raise SystemExit(
+            f"--synthetic-size {args.synthetic_size} must be a multiple "
+            f"of --global-batch {args.global_batch} (>= 2 steps/epoch)")
+    if args.epochs < 3:
+        raise SystemExit("the fleet scenario needs --epochs >= 3 (one "
+                         "epoch per phase: full world, shrunken world, "
+                         "grown world)")
+    target_step = spe * args.epochs
+    gen_chaos = _parse_gen_chaos(args.gen_chaos, spe, target_step)
+    capacity = [int(x) for x in args.capacity.split(",") if x.strip()]
+
+    snapshots: Dict[int, Path] = {}
+
+    def snapshot(generation: int, _launch) -> None:
+        # the checkpoint directory AS THE NEXT GENERATION WILL SEE IT —
+        # the parity control relaunches from exactly this state
+        dest = base / f"snap_gen{generation}"
+        if dest.exists():
+            shutil.rmtree(dest)
+        if ckpt_dir.exists():
+            shutil.copytree(ckpt_dir, dest)
+            snapshots[generation] = dest
+
+    orch = FleetOrchestrator(
+        lambda world, generation, resume: _train_argv(
+            args, world, resume, gen_chaos.get(generation),
+            str(ckpt_dir), str(out_dir)),
+        ckpt_dir, global_batch=args.global_batch,
+        target_step=target_step, capacity_for=capacity,
+        max_launches=args.max_launches, on_child_exit=snapshot)
+    # flights already present belong to a PREVIOUS fleet run over this
+    # --ckpt-dir — excluded from this run's per-generation accounting
+    pre_existing_flights = set(Path(out_dir).glob("flight_*.json"))
+    report = orch.run()
+
+    flight_stats = check_fleet_flights(out_dir, report.launches,
+                                       ignore=pre_existing_flights)
+
+    parity = None
+    if (report.completed and not args.no_verify_parity
+            and len(report.launches) > 1):
+        final = report.launches[-1]
+        snap = snapshots.get(final["generation"] - 1)
+        if snap is not None:
+            control_ckpt = base / "control_ckpt"
+            if control_ckpt.exists():
+                shutil.rmtree(control_ckpt)
+            shutil.copytree(snap, control_ckpt)
+            control_out = base / "control_out"
+            argv = _train_argv(args, final["world"], resume=True,
+                               chaos=None, ckpt_dir=str(control_ckpt),
+                               out_dir=str(control_out))
+            orch.log(f"fleet: parity control — uninterrupted relaunch at "
+                     f"world {final['world']} from the last handoff")
+            env = orch._child_env(final["world"], final["generation"])
+            env.pop(FLEET_GENERATION_ENV, None)
+            env.pop(FLEET_RANK_ENV, None)
+            ctl_log = orch.log_dir / "control.log"
+            with open(ctl_log, "wb") as lf:
+                rc = subprocess.run(argv, env=env, stdout=lf,
+                                    stderr=subprocess.STDOUT).returncode
+            if rc != 0:
+                report.errors.append(f"parity control child exited {rc}")
+                parity = False
+            else:
+                parity = _compare_final_checkpoints(
+                    str(ckpt_dir), str(control_ckpt), log=orch.log)
+
+    # "proved nothing" guards (the chaos CLI's discipline): a scheduled
+    # chaos scenario whose run never relaunched exercised none of the
+    # machinery this command exists to verify, and a relaunching run
+    # whose parity control could not be evaluated proved only half
+    if gen_chaos and report.relaunches == 0:
+        report.errors.append(
+            "chaos was scheduled but the fleet never relaunched — the "
+            "kill/shrink/grow machinery was not exercised (chaos step "
+            "past the run's end, or a reused directory already at the "
+            "target)")
+    if (not args.no_verify_parity and report.relaunches > 0
+            and parity is None):
+        report.errors.append(
+            "parity control could not be evaluated (missing handoff "
+            "snapshot or un-restorable checkpoints)")
+
+    stats = {"metric": "fleet_chaos", "dir": str(base),
+             "worlds": [launch["world"] for launch in report.launches],
+             "gen_chaos": {str(k): v for k, v in gen_chaos.items()},
+             "parity_bitwise": parity,
+             **flight_stats, **report.as_dict()}
+    ok = (report.completed and parity is not False
+          and flight_stats["flights_ok"]
+          and report.mismatch_escapes == 0
+          and not (gen_chaos and report.relaunches == 0)
+          and (args.no_verify_parity or report.relaunches == 0
+               or parity is True))
+    if args.as_json:
+        print(json.dumps(stats, sort_keys=True))
+    else:
+        for launch in report.launches:
+            print(f"generation {launch['generation']}: world "
+                  f"{launch['world']} rc={launch['rc']} "
+                  f"{launch['outcome']} (step {launch['step_after']}/"
+                  f"{target_step}, {launch['seconds']:.1f}s)")
+        print(f"final step: {report.final_step}/{target_step} at world "
+              f"{report.final_world}")
+        print(f"flights: {len(flight_stats['flights'])} "
+              f"(ok={flight_stats['flights_ok']})")
+        for problem in flight_stats["flight_problems"]:
+            print(f"flight problem: {problem}")
+        print(f"parity_bitwise: {parity}")
+        for err in report.errors:
+            print(f"error: {err}", file=sys.stderr)
+        print("fleet: RECOVERED" if ok else "fleet: FAILED")
+    return 0 if ok else 1

@@ -49,9 +49,15 @@ class SupervisorError(RuntimeError):
 class RetryPolicy:
     """Bounded exponential backoff with deterministic jitter.
 
-    ``max_restarts`` counts restore-and-replay attempts across the whole
-    run (not per fault). Jitter is seeded so chaos runs are reproducible;
-    restart n sleeps ``min(base * factor^(n-1), max) * (1 + jitter * u)``
+    ``max_restarts`` bounds CONSECUTIVE restore-and-replay attempts: a
+    completed clean segment (train + save + barrier, no exception) resets
+    the counter — and with it the backoff exponent — back to zero
+    (ISSUE 12; previously the counter only ever grew, so a long run with
+    sporadic faults spread hours apart still exhausted the budget and
+    died). Only a fault loop that cannot get one segment through gives
+    up; ``RunReport.restarts`` still counts every restart over the whole
+    run. Jitter is seeded so chaos runs are reproducible; consecutive
+    attempt n sleeps ``min(base * factor^(n-1), max) * (1 + jitter * u)``
     with ``u ~ U[0, 1)`` from the policy's own RNG stream."""
 
     max_restarts: int = 3
@@ -85,10 +91,13 @@ class RunReport:
     faults_fired: List[str] = dataclasses.field(default_factory=list)
     faults_unfired: List[str] = dataclasses.field(default_factory=list)
     failures: List[str] = dataclasses.field(default_factory=list)
-    # elastic resizes (replan_cb): one record per mesh re-plan —
-    # {from_world, to_world, survivors, label, epoch, step} where `label`
-    # is the checkpoint the resharded restore came from (None = the resize
-    # restarted from scratch) and (epoch, step) is where it resumed
+    # elastic resizes: one record per mesh re-plan — {from_world,
+    # to_world, survivors, label, epoch, step, direction} where `label` is
+    # the checkpoint anchoring the resize (the resharded restore's label
+    # for a shrink; the boundary save's for a grow; None = no checkpoint
+    # manager / restarted from scratch), (epoch, step) is where the run
+    # resumed, and direction is "shrink" (replica_death restart) or
+    # "grow" (capacity-return boundary re-plan, ISSUE 12)
     resizes: List[dict] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> dict:
@@ -124,6 +133,14 @@ class Supervisor:
     bare lethal rc=70, so the relaunch resumes instead of replaying the
     epoch (ROADMAP "resilience follow-ups").
 
+    ``capacity_watch`` (with ``replan_cb``) arms BIDIRECTIONAL elasticity
+    (ISSUE 12): replica deaths debit the watch (its count feeds the
+    shrink re-plan's survivors), and when returned capacity makes a
+    larger feasible world available the supervisor GROWS at the next
+    segment boundary — drain, checkpoint (the anchor label), re-plan UP,
+    reshard the live state, continue. A grow is not a restart: nothing
+    replays, no flight is flushed, the retry budget is untouched.
+
     Async saves: segment checkpoints ride the CheckpointManager's
     background writer (training continues over the orbax write + manifest
     hashing); a failed write surfaces at the next save/wait barrier, which
@@ -142,6 +159,7 @@ class Supervisor:
                  epoch_end_cb: Optional[Callable[..., None]] = None,
                  deathwatch=None,
                  replan_cb: Optional[Callable[[int], Any]] = None,
+                 capacity_watch=None,
                  sleep: Callable[[float], None] = time.sleep):
         if checkpoint_every_steps is not None and checkpoint_every_steps <= 0:
             raise ValueError("checkpoint_every_steps must be positive "
@@ -167,7 +185,19 @@ class Supervisor:
         # reshards (resilience/elastic.py) when the checkpoint's world
         # differs from the new one. None = fixed-world behavior, verbatim.
         self.replan_cb = replan_cb
+        # Grow side (ISSUE 12): a resilience.capacity.CapacityWatch the
+        # replica deaths debit and capacity returns credit. Polled at
+        # SEGMENT BOUNDARIES only (after the segment's checkpoint): when
+        # available > current world AND the replan finds a larger
+        # feasible world, the LIVE state reshards M -> N in place and the
+        # run continues — no restart, no replay, one `elastic_grow` span.
+        self.capacity_watch = capacity_watch
         self.sleep = sleep
+        # consecutive restore-and-replay attempts since the last CLEAN
+        # segment — the RetryPolicy's budget/backoff index (resets to 0
+        # after every completed segment; report.restarts never resets)
+        self._consecutive_failures = 0
+        self._last_saved_label: Optional[int] = None
         self._last_step_entered = -1
         self._saved_labels: set = set()
         self._skipped_labels: set = set()
@@ -233,6 +263,7 @@ class Supervisor:
         self.ckpt.save(label, state, epoch=save_epoch,
                        step_in_epoch=in_epoch, world_size=self._world)
         self._saved_labels.add(label)
+        self._last_saved_label = label  # the grow anchor (resize record)
 
     def _replan(self, err: ReplicaDeathError, report: RunReport) -> dict:
         """The elastic resize: hand the surviving replica count to
@@ -245,6 +276,13 @@ class Supervisor:
         survivors = getattr(err, "survivors", None)
         if survivors is None:
             survivors = (old_world - 1) if old_world else None
+        if survivors is not None and self.capacity_watch is not None:
+            # keep the registry consistent with the shrink decision: a
+            # death re-plans over the surviving ACTIVE replicas, so the
+            # boundary poll must not see phantom idle capacity and grow
+            # straight back mid-incident (capacity genuinely returning
+            # goes through watch.restore — the capacity_return fault)
+            self.capacity_watch.sync(survivors)
         if not survivors or survivors < 1:
             err2 = SupervisorError(
                 f"replica death at world size {old_world} leaves no "
@@ -273,8 +311,91 @@ class Supervisor:
         log_main(f"supervisor: elastic resize — mesh re-planned "
                  f"{old_world} -> {plan.world} replicas "
                  f"({survivors} survivor(s)); restoring and resharding")
+        # a death restart normally shrinks, but capacity that returned
+        # before the restart can make the re-plan land larger — direction
+        # records what actually happened, not the trigger
         return {"from_world": old_world, "to_world": plan.world,
-                "survivors": survivors}
+                "survivors": survivors,
+                "direction": ("grow" if old_world is not None
+                              and plan.world > old_world else "shrink")}
+
+    def _maybe_grow(self, report: RunReport, state, epoch: int,
+                    step: int):
+        """Segment-boundary grow poll (ISSUE 12): when the capacity
+        registry reports more replicas than the current world AND the
+        re-plan finds a larger feasible world (divides the fixed global
+        batch), reshard the LIVE state into the new world's layout and
+        swap the rig — no restart, no replay, no data-order change (the
+        sampler/fence/per-step RNG are world-independent by the elastic
+        design). The just-written segment checkpoint anchors the resize
+        record: the parity control restores THAT label at its recorded
+        world and reshards the same way (``resilience chaos --elastic``).
+        Returns the (possibly resharded) state."""
+        avail = self.capacity_watch.poll_grow(self._world)
+        if avail is None:
+            return state
+        plan = self.replan_cb(avail)
+        if self._world is not None and plan.world <= self._world:
+            # capacity returned in a quantity no feasible world can use
+            # (e.g. 5 available, global batch 16): keep training at M —
+            # the poll repeats at the next boundary
+            return state
+        if len(plan.loader) != len(self.loader):
+            err = SupervisorError(
+                f"elastic grow re-plan changed steps-per-epoch "
+                f"({len(self.loader)} -> {len(plan.loader)}) — the replan "
+                "must keep the GLOBAL batch fixed (shrink the per-device "
+                "batch), or the step fence and sampler schedule no longer "
+                "describe the same trajectory")
+            err.report = report
+            raise err
+        if self.ckpt is not None:
+            try:
+                # the anchor must be DURABLE before the rig swaps: the
+                # resize record names the just-saved label and the parity
+                # control restores it — at a mid-epoch boundary that save
+                # may still be on the async writer, and anchoring a grow
+                # on a write that later fails would score a correct
+                # recovery as a parity failure
+                self.ckpt.wait()
+            except Exception as e:  # noqa: BLE001 — the anchor save was
+                # lost; its label is torn (pending marker) and later
+                # restores skip it. Defer the grow: the capacity is still
+                # there and the poll repeats at the next boundary, where
+                # a fresh segment save anchors it.
+                report.failures.append(
+                    f"{type(e).__name__}: {e} (anchor save lost at a "
+                    "grow boundary — grow deferred to the next segment)")
+                log_main(f"supervisor: grow deferred — the boundary "
+                         f"checkpoint's async write failed "
+                         f"({type(e).__name__}: {e}); the label is torn "
+                         "and the next boundary re-anchors")
+                return state
+        old_world = self._world
+        from .elastic import reshard_train_state
+
+        with _telemetry.span("elastic_grow", from_world=old_world,
+                             to_world=plan.world, available=avail):
+            state = reshard_train_state(state, old_world, plan.world,
+                                        plan.trainer,
+                                        plan.state_factory())
+        self.trainer = plan.trainer
+        self.loader = plan.loader
+        self.state_factory = plan.state_factory
+        self._world = plan.world
+        self._factories[plan.world] = plan.state_factory
+        _telemetry.counter("elastic_resizes", 1, from_world=old_world,
+                           to_world=plan.world, direction="grow")
+        report.resizes.append({
+            "from_world": old_world, "to_world": plan.world,
+            "survivors": avail, "label": self._last_saved_label,
+            "epoch": epoch, "step": step, "direction": "grow"})
+        log_main(f"supervisor: elastic GROW — capacity returned "
+                 f"({avail} available), mesh re-planned {old_world} -> "
+                 f"{plan.world} replicas at epoch {epoch} step {step} "
+                 f"(live reshard, anchor checkpoint "
+                 f"{self._last_saved_label}; sampler/RNG unchanged)")
+        return state
 
     def _template_for_world(self, world: Optional[int]):
         """Restore template for a checkpoint recorded at ``world`` batch
@@ -433,16 +554,18 @@ class Supervisor:
                              "checkpoint)")
                     break
                 report.restarts += 1
+                self._consecutive_failures += 1
                 report.failures.append(f"{type(e).__name__}: {e}")
                 # the per-failure postmortem: the injected chaos faults'
                 # flight artifacts carry the fault label verbatim in the
                 # cause (e.g. "FaultError: injected crash@step=3")
                 flush_flight(
                     cause=f"{type(e).__name__}: {e}",
-                    detail=f"supervisor restart {report.restarts}/"
-                           f"{self.retry.max_restarts}")
+                    detail=f"supervisor restart {report.restarts} "
+                           f"(consecutive {self._consecutive_failures}/"
+                           f"{self.retry.max_restarts})")
                 _telemetry.counter("restarts", 1)
-                if report.restarts > self.retry.max_restarts:
+                if self._consecutive_failures > self.retry.max_restarts:
                     report.final_step = -1
                     if self.injector is not None:
                         report.faults_fired = list(self.injector.fired)
@@ -454,12 +577,12 @@ class Supervisor:
                         detail="SupervisorError", rc=1)
                     err = SupervisorError(
                         f"giving up after {self.retry.max_restarts} "
-                        f"restart(s); last failure: {e}")
+                        f"consecutive restart(s); last failure: {e}")
                     err.report = report  # the chaos CLI reports even a loss
                     raise err from e
-                delay = self.retry.delay_s(report.restarts, rng)
+                delay = self.retry.delay_s(self._consecutive_failures, rng)
                 log_main(f"supervisor: step failure ({type(e).__name__}: "
-                         f"{e}) — restart {report.restarts}/"
+                         f"{e}) — restart {self._consecutive_failures}/"
                          f"{self.retry.max_restarts} in {delay:.2f}s")
                 self.sleep(delay)
                 # elastic resize rides THIS restart (already counted,
@@ -481,6 +604,14 @@ class Supervisor:
                         0, self._last_step_entered - restored_abs)
                 continue
 
+            # the segment completed CLEAN (train + save + barrier): the
+            # retry budget and backoff exponent reset — max_restarts
+            # bounds consecutive failures, not lifetime faults (a long
+            # run with sporadic faults hours apart must not die on its
+            # Nth isolated fault; only a loop that can't get one segment
+            # through exhausts the budget)
+            self._consecutive_failures = 0
+
             if step >= spe:
                 # epoch complete — BEFORE the drain check: a preemption
                 # landing exactly at the boundary must still emit the
@@ -489,6 +620,20 @@ class Supervisor:
                 if self.epoch_end_cb is not None:
                     self.epoch_end_cb(epoch, state, loss, acc, seconds)
                 epoch, step = epoch + 1, 0
+
+            if (self.capacity_watch is not None
+                    and self.replan_cb is not None and epoch < epochs
+                    and not (self.deathwatch is not None
+                             and self.deathwatch.died.is_set())
+                    and not (self.guard is not None
+                             and self.guard.should_stop)):
+                # the GROW side of elasticity (ISSUE 12): the segment is
+                # drained and its checkpoint written — the only place a
+                # resize can anchor — so poll the capacity registry and
+                # re-plan UP when returned capacity admits a larger
+                # feasible world. A dying run (relay death / preemption
+                # drain pending below) never grows on its way out.
+                state = self._maybe_grow(report, state, epoch, step)
 
             if (self.deathwatch is not None
                     and self.deathwatch.died.is_set() and epoch < epochs):

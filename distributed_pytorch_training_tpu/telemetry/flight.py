@@ -39,6 +39,26 @@ from . import recorder as _recorder
 
 _SEQ = itertools.count()
 
+# Fleet context (ISSUE 12): the cross-process orchestrator
+# (resilience/fleet.py) stamps every child it launches with its launch
+# generation and rank. A postmortem that cannot say WHICH launch of a
+# relaunch sequence died is half a postmortem — the context rides in the
+# flight's cause (and as structured fields), read straight from the env
+# so no plumbing crosses the library.
+FLEET_GENERATION_ENV = "DPT_FLEET_GENERATION"
+FLEET_RANK_ENV = "DPT_FLEET_RANK"
+
+
+def _fleet_context() -> dict:
+    ctx = {}
+    gen = os.environ.get(FLEET_GENERATION_ENV)
+    rank = os.environ.get(FLEET_RANK_ENV)
+    if gen is not None:
+        ctx["fleet_generation"] = gen
+    if rank is not None:
+        ctx["fleet_rank"] = rank
+    return ctx
+
 
 def flush_flight(cause: str, detail: str = "", rc: Optional[int] = None,
                  directory: Optional[str] = None,
@@ -52,6 +72,14 @@ def flush_flight(cause: str, detail: str = "", rc: Optional[int] = None,
             rec.directory if rec is not None else None)
         if out_dir is None:
             return None
+        fleet = _fleet_context()
+        if fleet:
+            # a fleet-launched child names its launch generation + rank in
+            # the cause itself (the first thing anyone reads), so a
+            # relaunch sequence's postmortems are attributable at a glance
+            cause = (f"{cause} [fleet gen="
+                     f"{fleet.get('fleet_generation', '?')} rank="
+                     f"{fleet.get('fleet_rank', '?')}]")
         events = rec.tail(rec.ring.maxlen) if rec is not None else []
         body = {
             "schema": _recorder.SCHEMA_VERSION,
@@ -64,6 +92,7 @@ def flush_flight(cause: str, detail: str = "", rc: Optional[int] = None,
             "run_id": rec.run_id if rec is not None else None,
             "n_events": len(events),
             "events": events,
+            **fleet,
         }
         if extra:
             body.update(extra)

@@ -57,17 +57,20 @@ SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync", "eval",
 # latency story decomposes instead of lumping into "unaccounted".
 SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain")
 
-# The elastic phases (ISSUE 11): mesh re-planning after a replica death
-# and the checkpoint reshard (N -> M re-slice). Bucketed by `telemetry
-# summary` like every other canonical phase instead of lumping into
-# "unaccounted". The `compile` span (the serving engine's per-program
-# AOT instrument — with the persistent compile cache on it collapses to
-# cache-load time, the restart-downtime win) is deliberately NOT in this
-# accounting list: a lazy compile runs INSIDE the prefill/decode/
-# step_dispatch span that triggered it, so summing it as its own phase
-# would double-count the same wall time; it stays visible in the summary's
-# spans table under its own name.
-ELASTIC_SPAN_NAMES = ("elastic_replan", "elastic_reshard")
+# The elastic phases (ISSUEs 11 + 12): mesh re-planning after a replica
+# death, the checkpoint reshard (N -> M re-slice), the grow-side live
+# reshard when preempted capacity returns (`elastic_grow`), and the
+# Supervisor's segment-boundary capacity polls (`capacity_watch`).
+# Bucketed by `telemetry summary` like every other canonical phase
+# instead of lumping into "unaccounted". The `compile` span (the serving
+# engine's per-program AOT instrument — with the persistent compile cache
+# on it collapses to cache-load time, the restart-downtime win) is
+# deliberately NOT in this accounting list: a lazy compile runs INSIDE
+# the prefill/decode/step_dispatch span that triggered it, so summing it
+# as its own phase would double-count the same wall time; it stays
+# visible in the summary's spans table under its own name.
+ELASTIC_SPAN_NAMES = ("elastic_replan", "elastic_reshard", "elastic_grow",
+                      "capacity_watch")
 
 
 class Recorder:

@@ -74,10 +74,16 @@ class CheckpointWorldSizeMismatch(RuntimeError):
     template built for another — the flat-padded layouts (zero1 moments,
     fsdp params+moments, EF residuals) change shape with the shard count,
     so orbax's opaque tree-mismatch dump is really THIS error. Raised with
-    both sizes in the message; resolve by restoring through
+    both sizes in the message and the chosen candidate on the instance
+    (``label`` / ``world_size`` — train.py's elastic-resume fallback
+    restores exactly that label raw instead of re-scanning the
+    directory); resolve by restoring through
     ``restore_latest(template_factory=...)`` (build the template at the
     checkpoint's recorded world size and reshard — resilience/elastic.py)
     or by resuming at the original world size."""
+
+    label: Optional[int] = None
+    world_size: Optional[int] = None
 
 
 def _file_sha256(path: Path) -> str:
@@ -460,6 +466,59 @@ class CheckpointManager:
         w = manifest.get("world_size")
         return int(w) if w is not None else None
 
+    def _verified_labels(self, among=None):
+        """Candidate labels, newest first, that PASS integrity
+        verification — the shared front half of every restore: joins the
+        writer, resets + records ``last_skipped``, logs each torn skip
+        loudly. A generator so callers stop at the first hit."""
+        self._join_writer(reraise=False)
+        self.last_skipped = []
+        labels = sorted((label for label in self._mgr.all_steps()
+                         if among is None or label in among), reverse=True)
+        for label in labels:
+            problem = self.verify(label)
+            if problem is not None:
+                log_main(f"CHECKPOINT INTEGRITY: checkpoint {label} is "
+                         f"torn ({problem}) — skipping it and trying the "
+                         "previous one")
+                telemetry.emit("event", "torn_checkpoint_skipped",
+                               label=label, problem=problem)
+                self.last_skipped.append(label)
+                continue
+            yield label
+
+    def restore_latest_raw(
+        self, among=None,
+    ) -> Optional[Tuple[dict, int, Optional[int], int, int]]:
+        """Newest VALID checkpoint as HOST numpy arrays in their SAVED
+        shapes — no template. Returns ``(arrays, label, world_size,
+        epoch, step_in_epoch)`` or None; torn checkpoints are skipped
+        exactly as in :meth:`restore_latest`.
+
+        The cross-PROCESS elastic restore (ISSUE 12): a fleet relaunch at
+        a different world size cannot build the old world's device
+        templates (that mesh no longer exists in this process), so the
+        checkpoint's own saved shapes stand in for the template and the
+        caller reshards the host arrays into its current layout
+        (``resilience.elastic.reshard_raw_state``). Orbax reconstructs
+        the saved pytree as plain nested containers whose flattened leaf
+        order mirrors the saved TrainState's (both sides flatten the same
+        structure), so positional re-unflattening onto a matching
+        template treedef is exact — the reshard's per-leaf shape checks
+        catch a structural drift loudly."""
+        for label in self._verified_labels(among):
+            with telemetry.span("restore", label=label, raw=True):
+                restored = self._mgr.restore(
+                    label, args=ocp.args.StandardRestore())
+            self.last_restored = label
+            return (restored, label, self.checkpoint_world_size(label),
+                    int(restored["epoch"]), int(restored["step_in_epoch"]))
+        if self.last_skipped:
+            log_main(f"CHECKPOINT INTEGRITY: every checkpoint "
+                     f"({self.last_skipped}) failed verification — "
+                     "nothing to restore")
+        return None
+
     def restore_latest(
         self, template: Optional[TrainState] = None, among=None,
         template_factory=None, template_world_size: Optional[int] = None,
@@ -490,20 +549,7 @@ class CheckpointManager:
         if (template is None) == (template_factory is None):
             raise ValueError("restore_latest needs exactly one of "
                              "`template` or `template_factory`")
-        self._join_writer(reraise=False)
-        self.last_skipped = []
-        labels = sorted((label for label in self._mgr.all_steps()
-                         if among is None or label in among), reverse=True)
-        for label in labels:
-            problem = self.verify(label)
-            if problem is not None:
-                log_main(f"CHECKPOINT INTEGRITY: checkpoint {label} is "
-                         f"torn ({problem}) — skipping it and trying the "
-                         "previous one")
-                telemetry.emit("event", "torn_checkpoint_skipped",
-                               label=label, problem=problem)
-                self.last_skipped.append(label)
-                continue
+        for label in self._verified_labels(among):
             saved_world = self.checkpoint_world_size(label)
             if template_factory is not None:
                 tmpl = template_factory(saved_world)
@@ -518,7 +564,7 @@ class CheckpointManager:
                     # can silently truncate a flat-padded leaf into the
                     # smaller-world template, which corrupts the state
                     # instead of failing
-                    raise CheckpointWorldSizeMismatch(
+                    err = CheckpointWorldSizeMismatch(
                         f"checkpoint {label} was written at world size "
                         f"{saved_world} (DP batch shards), but the "
                         "restore template was built for world size "
@@ -529,6 +575,13 @@ class CheckpointManager:
                         "(restore_latest(template_factory=...)) and "
                         "reshard via resilience.elastic, or resume at "
                         "the original world size")
+                    # the already-verified, already-chosen candidate: an
+                    # elastic-resume fallback restores exactly this label
+                    # (among={err.label}) instead of re-scanning — and
+                    # re-hashing — every candidate from scratch
+                    err.label = label
+                    err.world_size = saved_world
+                    raise err
             return self._restore(label, tmpl)
         if self.last_skipped:
             log_main(f"CHECKPOINT INTEGRITY: every checkpoint "

@@ -24,17 +24,19 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "gsync_bf16_accum", "gsync_int8_mh",
                              "gsync_int8_mh_accum", "gsync_int8_mh_fused",
                              "fsdp", "fsdp_accum", "fsdp_int8_mh",
-                             "serving_decode", "elastic_reshard"}
+                             "serving_decode", "elastic_reshard",
+                             "elastic_grow"}
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran, incl. the fsdp rules (ISSUE 7), the
-    # serving decode-step rules (ISSUE 10) and the elastic-reshard census
-    # pin (ISSUE 11)
+    # serving decode-step rules (ISSUE 10) and the elastic census pins in
+    # BOTH directions (ISSUEs 11 + 12)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
     assert "fsdp-layer-gather-bound" in kinds
     assert "decode-cache-donated" in kinds
     assert "no-host-sync-in-decode" in kinds
     assert "elastic-reshard-census" in kinds
+    assert "elastic-grow-census" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

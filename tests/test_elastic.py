@@ -70,6 +70,30 @@ class TestReshardFlatPadded:
                                    flat_padded_size(true_size, old_n))
         np.testing.assert_array_equal(back, old)
 
+    def test_grow_with_smaller_total_padding(self):
+        """ISSUE-12 satellite: the grow direction where the new PADDED
+        length is SMALLER than the old one — true size 9 at old world 8
+        pads to 16 (2/shard), but at new world 3 pads to only 9
+        (3/shard): growing the per-shard chunk SHRINKS the total, and the
+        re-slice must truncate exactly the 7 pad zeros, no more."""
+        content = np.arange(1, 10, dtype=np.float32)          # true 9
+        old = np.pad(content, (0, flat_padded_size(9, 8) - 9))  # len 16
+        assert old.shape == (16,)
+        new = reshard_flat_padded(old, flat_padded_size(9, 3))  # len 9
+        assert new.shape == (9,)
+        np.testing.assert_array_equal(new, content)
+        # and the mirror: 3 -> 8 re-pads with zeros, content untouched
+        back = reshard_flat_padded(new, flat_padded_size(9, 8))
+        np.testing.assert_array_equal(back, old)
+
+    def test_grow_truncation_still_guards_content(self):
+        """Same shape transition, but with real content smuggled into
+        what should be the pad region — the truncating grow must refuse
+        as loudly as a shrink does."""
+        bad = np.arange(1, 17, dtype=np.float32)  # nonzero through 16
+        with pytest.raises(ValueError, match="NONZERO tail"):
+            reshard_flat_padded(bad, 9)
+
     def test_nonzero_tail_is_loud(self):
         """Shrinking must refuse to drop real content — a nonzero tail
         means the input was never a zero-padded flat layout."""
@@ -109,6 +133,33 @@ class TestFoldEfRows:
         grown = fold_ef_rows(rows, 4)
         np.testing.assert_array_equal(grown[:2], rows)
         assert not grown[2:].any()
+
+    def test_grow_with_nonzero_residuals_preserves_totals(self):
+        """ISSUE-12 satellite: M -> N grow with NONZERO residual rows —
+        the returning replicas join with zero carried error while the
+        survivors keep theirs bit-for-bit, so the telescoping column
+        total is preserved exactly (what re-enters the next reduction)."""
+        rows = np.random.RandomState(3).randn(4, 9).astype(np.float32)
+        grown = fold_ef_rows(rows, 8)
+        assert grown.shape == (8, 9)
+        np.testing.assert_array_equal(grown[:4], rows)  # survivors exact
+        assert not grown[4:].any()                      # newcomers zero
+        np.testing.assert_array_equal(grown.sum(axis=0, dtype=np.float64),
+                                      rows.sum(axis=0, dtype=np.float64))
+
+    def test_non_divisor_fold_both_directions(self):
+        """8 -> 3 folds rows {m, m+3, m+6}; 3 -> 8 zero-extends — the
+        fold never requires the worlds to divide each other."""
+        rows = np.random.RandomState(4).randn(8, 6).astype(np.float64)
+        down = fold_ef_rows(rows, 3)
+        for m in range(3):
+            expect = np.zeros(6)
+            for i in range(m, 8, 3):
+                expect = expect + rows[i]
+            np.testing.assert_array_equal(down[m], expect)
+        up = fold_ef_rows(down, 8)
+        np.testing.assert_array_equal(up[:3], down)
+        assert not up[3:].any()
 
 
 class TestMultihopAndFsdpRowReshard:
@@ -286,6 +337,93 @@ class TestReshardTrainState:
                     assert not oleaf[k:].any() and not nleaf[k:].any()
                     ooff, noff = ooff + co, noff + cn
 
+    def test_zero1_int8_state_grows_exactly(self, mesh8, mesh4):
+        """ISSUE-12: the GROW direction at state level — a zero1-int8
+        state trained at world 4 reshards to the world-8 template with
+        flat leaves zero-extended, EF rows zero-extended (survivors keep
+        their residual bit-for-bit, newcomers start at zero), and the
+        world-8 trainer trains on it."""
+        t4, sf4, l4 = _rig(mesh4, "zero1", "int8")
+        state = sf4()
+        state, *_ = t4.train_epoch(state, l4.epoch(0), 0, len(l4))
+        t8, sf8, l8 = _rig(mesh8, "zero1", "int8")
+        new = reshard_train_state(state, 4, 8, t8, sf8())
+
+        assert int(new.step) == int(state.step)
+        _flat_leaves_match(new.params, state.params)  # grow: new >= old
+        _flat_leaves_match(new.opt_state, state.opt_state)
+        for old, grown in zip(
+                jax.tree_util.tree_leaves(state.grad_sync["ef"]),
+                jax.tree_util.tree_leaves(new.grad_sync["ef"])):
+            o = np.asarray(jax.device_get(old))
+            n = np.asarray(jax.device_get(grown))
+            assert o.shape[0] == 4 and n.shape[0] == 8
+            for m in range(4):
+                np.testing.assert_array_equal(n[m][:o.shape[1]], o[m])
+                assert not n[m][o.shape[1]:].any()
+            assert not n[4:].any()  # returning replicas carry no error
+        cont, *_ = t8.train_epoch(new, l8.epoch(1), 1, len(l8))
+        assert int(cont.step) == int(state.step) + len(l8)
+
+    def test_raw_reshard_matches_device_reshard(self, mesh8, mesh4,
+                                                tmp_path):
+        """The cross-PROCESS restore path (ISSUE 12): save a zero1-int8
+        state at world 8, restore it RAW (no template — the checkpoint's
+        own shapes), reshard via reshard_raw_state to world 4, and pin
+        the result BITWISE against the in-process reshard_train_state of
+        the live state — the fleet relaunch path and the supervisor path
+        are the same re-slice."""
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            reshard_raw_state,
+        )
+        from distributed_pytorch_training_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        t8, sf8, l8 = _rig(mesh8, "zero1", "int8")
+        state = sf8()
+        state, *_ = t8.train_epoch(state, l8.epoch(0), 0, len(l8))
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(2, state, epoch=0, step_in_epoch=2, world_size=8)
+        mgr.wait()
+        raw = mgr.restore_latest_raw()
+        mgr.close()
+        assert raw is not None
+        arrays, label, world, epoch, step = raw
+        assert (label, world, epoch, step) == (2, 8, 0, 2)
+
+        t4, sf4, _l4 = _rig(mesh4, "zero1", "int8")
+        via_raw = reshard_raw_state(arrays, 8, 4, t4, sf4())
+        via_live = reshard_train_state(state, 8, 4, t4, sf4())
+        for a, b in zip(jax.tree_util.tree_leaves(via_raw),
+                        jax.tree_util.tree_leaves(via_live)):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(a)),
+                np.asarray(jax.device_get(b)))
+
+    def test_raw_reshard_config_drift_is_loud(self, mesh8, mesh4,
+                                              tmp_path):
+        """A relaunch that changed the training config (here: dropped the
+        int8 wire, so the EF subtree vanished from the template) must
+        fail with a named leaf-count error, never a silent positional
+        mis-pairing."""
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            reshard_raw_state,
+        )
+        from distributed_pytorch_training_tpu.training.checkpoint import (
+            CheckpointManager,
+        )
+
+        t8, sf8, _l8 = _rig(mesh8, "zero1", "int8")
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(1, sf8(), epoch=0, world_size=8)
+        mgr.wait()
+        raw = mgr.restore_latest_raw()
+        mgr.close()
+        t4, sf4, _l4 = _rig(mesh4, "zero1", "fp32")
+        with pytest.raises(ValueError, match="grad_sync.*training config"):
+            reshard_raw_state(raw[0], 8, 4, t4, sf4())
+
     def test_shape_mismatch_beyond_flat_is_loud(self, mesh8, mesh4):
         """A leaf that changes shape in any way other than 1-D flat
         padding is a structure error, never a silent cast."""
@@ -336,8 +474,11 @@ class TestCheckpointWorldSize:
         mgr.save(2, sf8(), epoch=0, step_in_epoch=2, world_size=8)
         mgr.wait()
         with pytest.raises(CheckpointWorldSizeMismatch,
-                           match=r"world size 8.*world size 4"):
+                           match=r"world size 8.*world size 4") as exc:
             mgr.restore_latest(sf4(), template_world_size=4)
+        # the chosen candidate rides the exception so the elastic-resume
+        # fallback restores it directly instead of re-scanning
+        assert exc.value.label == 2 and exc.value.world_size == 8
         mgr.close()
 
     def test_ef_only_world_change_is_caught(self, mesh8, mesh4, tmp_path):
@@ -442,3 +583,63 @@ class TestElasticReshardRule:
         a = StepArtifacts(name="x", optimized_text="",
                           config={"elastic_reshard": True})
         assert check_elastic_reshard_census(a)
+
+
+class TestElasticGrowRule:
+    """The GROW leg's census pin (ISSUE 12) — same comparator, mirror
+    direction; mutation-tested like every rule."""
+
+    def _artifact(self, expected):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            StepArtifacts,
+        )
+
+        text = ('%ar = f32[4096]{0} all-reduce(%x)\n'
+                '%ag = f32[4096]{0} all-gather(%y)\n')
+        return StepArtifacts(
+            name="elastic_grow_mut", optimized_text=text,
+            config={"elastic_grow": True,
+                    "elastic_expected_census": expected},
+            n_shards=8)
+
+    def test_matching_census_passes(self):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            check_elastic_grow_census,
+        )
+
+        ok = [{"op": "all-gather", "result_shape": "f32[4096]{0}",
+               "count": 1},
+              {"op": "all-reduce", "result_shape": "f32[4096]{0}",
+               "count": 1}]
+        assert check_elastic_grow_census(self._artifact(ok)) == []
+
+    def test_smuggled_collective_flags(self):
+        """The mutation: the grown step carries an all-gather the
+        clean-at-N census does not — the rule must name it."""
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            check_elastic_grow_census,
+        )
+
+        clean = [{"op": "all-reduce", "result_shape": "f32[4096]{0}",
+                  "count": 1}]
+        findings = check_elastic_grow_census(self._artifact(clean))
+        assert findings and "all-gather" in findings[0].message
+        assert findings[0].rule == "elastic-grow-census"
+
+    def test_inert_without_grow_config(self):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            StepArtifacts, check_elastic_grow_census,
+        )
+
+        a = StepArtifacts(name="x", optimized_text="",
+                          config={"elastic_reshard": True})
+        assert check_elastic_grow_census(a) == []
+
+    def test_missing_expectation_flags(self):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            StepArtifacts, check_elastic_grow_census,
+        )
+
+        a = StepArtifacts(name="x", optimized_text="",
+                          config={"elastic_grow": True})
+        assert check_elastic_grow_census(a)

@@ -1,0 +1,298 @@
+"""resilience/fleet.py (ISSUE 12): the cross-process orchestrator.
+
+Fast tests drive the orchestrator with STUB children (tiny scripts, no
+jax): worlds planned from the capacity feed, resume decisions from the
+manifest progress probe, generation/rank env stamping, exit-code
+interpretation, mismatch-escape detection, launch-budget exhaustion, and
+the per-generation flight accounting. The real train.py e2e — kill at
+full world -> relaunch at half world -> capacity return -> relaunch at
+full world, cross-world zero1 restores through train.py's elastic
+--resume, final checkpoint bitwise vs an uninterrupted control child —
+is the slow test at the bottom (also: `resilience fleet`).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+from distributed_pytorch_training_tpu.resilience.fleet import (
+    FLEET_GENERATION_ENV, FLEET_RANK_ENV, FleetOrchestrator,
+    _xla_flags_for, check_fleet_flights, checkpoint_progress,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+# One scripted child: reads its generation from the env, records what it
+# saw (argv tail + env) into the checkpoint dir, optionally fakes
+# checkpoint progress by writing a manifest, optionally prints a line,
+# and exits with the scripted rc.
+STUB = """\
+import json, os, sys
+from pathlib import Path
+
+gen = int(os.environ["{gen_env}"])
+ckpt = Path(sys.argv[1])
+plans = json.loads(Path(sys.argv[2]).read_text())
+plan = plans[min(gen, len(plans) - 1)]
+ckpt.mkdir(parents=True, exist_ok=True)
+(ckpt / "seen_gen{{}}.json".format(gen)).write_text(json.dumps({{
+    "args": sys.argv[3:],
+    "rank": os.environ.get("{rank_env}"),
+    "xla": os.environ.get("XLA_FLAGS", ""),
+    "platform": os.environ.get("JAX_PLATFORMS", ""),
+}}))
+if plan.get("step") is not None:
+    mdir = ckpt / ".manifests"
+    mdir.mkdir(exist_ok=True)
+    (mdir / "{{}}.json".format(plan["label"])).write_text(json.dumps(
+        {{"step": plan["step"], "world_size": plan.get("world")}}))
+if plan.get("print"):
+    print(plan["print"])
+sys.exit(plan["rc"])
+""".format(gen_env=FLEET_GENERATION_ENV, rank_env=FLEET_RANK_ENV)
+
+
+def _orchestrator(tmp_path, plans, capacity, *, global_batch=16,
+                  target_step=12, max_launches=8, on_child_exit=None):
+    stub = tmp_path / "stub_child.py"
+    stub.write_text(STUB)
+    plan_file = tmp_path / "plans.json"
+    plan_file.write_text(json.dumps(plans))
+    ckpt = tmp_path / "ckpt"
+
+    def argv_for(world, generation, resume):
+        return [sys.executable, str(stub), str(ckpt), str(plan_file),
+                f"world={world}", f"resume={resume}"]
+
+    return FleetOrchestrator(
+        argv_for, ckpt, global_batch=global_batch,
+        target_step=target_step, capacity_for=capacity,
+        max_launches=max_launches, on_child_exit=on_child_exit,
+        log=lambda _m: None), ckpt
+
+
+def _seen(ckpt, generation):
+    return json.loads((ckpt / f"seen_gen{generation}.json").read_text())
+
+
+class TestCheckpointProgress:
+    def test_empty_and_missing_dir(self, tmp_path):
+        assert checkpoint_progress(tmp_path) == (-1, None)
+        assert checkpoint_progress(tmp_path / "nope") == (-1, None)
+
+    def test_newest_finalized_label_wins(self, tmp_path):
+        mdir = tmp_path / ".manifests"
+        mdir.mkdir()
+        (mdir / "4.json").write_text(json.dumps({"step": 4,
+                                                 "world_size": 8}))
+        (mdir / "10.json").write_text(json.dumps({"step": 10,
+                                                  "world_size": 4}))
+        assert checkpoint_progress(tmp_path) == (10, 4)
+
+    def test_torn_and_foreign_manifests_ignored(self, tmp_path):
+        mdir = tmp_path / ".manifests"
+        mdir.mkdir()
+        (mdir / "4.json").write_text(json.dumps({"step": 4}))
+        (mdir / "12.json").write_text("{ torn")       # unparseable
+        (mdir / "notes.json").write_text("{}")        # non-integer stem
+        assert checkpoint_progress(tmp_path) == (4, None)
+
+
+class TestXlaFlags:
+    def test_replaces_inherited_device_count(self):
+        out = _xla_flags_for(
+            4, "--xla_cpu_foo=1 --xla_force_host_platform_device_count=8")
+        assert out == ("--xla_cpu_foo=1 "
+                       "--xla_force_host_platform_device_count=4")
+        assert _xla_flags_for(2) == \
+            "--xla_force_host_platform_device_count=2"
+
+
+class TestOrchestrator:
+    def test_kill_shrink_grow_scenario(self, tmp_path):
+        """The canonical sequence with stub children: gen0 crashes at
+        world 8 having checkpointed step 4; gen1 (capacity 4 -> world 4,
+        --resume) drains at step 10; gen2 (capacity back to 8) completes
+        at step 12. Worlds follow plan_elastic_world(capacity), resume
+        follows the manifest probe, every child is stamped with its
+        generation/rank and a world-sized device count."""
+        events = []
+        plans = [
+            {"rc": 1, "label": 4, "step": 4, "world": 8},
+            {"rc": 0, "label": 10, "step": 10, "world": 4},
+            {"rc": 0, "label": 12, "step": 12, "world": 8},
+        ]
+        orch, ckpt = _orchestrator(
+            tmp_path, plans, [8, 4, 8],
+            on_child_exit=lambda gen, launch: events.append(
+                (gen, launch.outcome)))
+        report = orch.run()
+        assert report.completed is True
+        assert report.relaunches == 2
+        assert [l["world"] for l in report.launches] == [8, 4, 8]
+        assert [l["outcome"] for l in report.launches] == \
+            ["crashed", "drained", "completed"]
+        assert [l["resume"] for l in report.launches] == \
+            [False, True, True]
+        assert report.final_step == 12 and report.final_world == 8
+        assert report.mismatch_escapes == 0 and report.errors == []
+        assert events == [(0, "crashed"), (1, "drained"),
+                          (2, "completed")]
+        for gen, world in ((0, 8), (1, 4), (2, 8)):
+            seen = _seen(ckpt, gen)
+            assert seen["rank"] == "0"
+            assert seen["platform"] == "cpu"
+            assert (f"--xla_force_host_platform_device_count={world}"
+                    in seen["xla"])
+            assert seen["args"] == [f"world={world}",
+                                    f"resume={gen > 0}"]
+
+    def test_capacity_feed_callable_and_non_divisor(self, tmp_path):
+        """A callable capacity feed, and a non-divisor capacity (7 of
+        global batch 16) planning down to the largest feasible world."""
+        plans = [{"rc": 0, "label": 12, "step": 12, "world": 4}]
+        orch, _ckpt = _orchestrator(tmp_path, plans, lambda gen: 7)
+        report = orch.run()
+        assert report.completed
+        assert [l["world"] for l in report.launches] == [4]
+        assert report.launches[0]["available"] == 7
+
+    def test_relay_death_rc70_is_named_and_relaunched(self, tmp_path):
+        plans = [
+            {"rc": 70, "label": 4, "step": 4, "world": 8},
+            {"rc": 0, "label": 12, "step": 12, "world": 8},
+        ]
+        orch, _ckpt = _orchestrator(tmp_path, plans, [8])
+        report = orch.run()
+        assert report.completed
+        assert [l["outcome"] for l in report.launches] == \
+            ["relay_death", "completed"]
+
+    def test_mismatch_escape_is_counted(self, tmp_path):
+        """A CheckpointWorldSizeMismatch surfacing in a child's output is
+        the exact failure the orchestrator exists to absorb — counted as
+        a hard error (the acceptance gate: zero escapes)."""
+        plans = [
+            {"rc": 1, "print": "CheckpointWorldSizeMismatch: checkpoint "
+                               "was written at world size 8"},
+            {"rc": 0, "label": 12, "step": 12, "world": 8},
+        ]
+        orch, _ckpt = _orchestrator(tmp_path, plans, [8])
+        report = orch.run()
+        assert report.completed  # the fleet still recovered...
+        assert report.mismatch_escapes == 1  # ...but the gate must fail
+        assert any("CheckpointWorldSizeMismatch" in e
+                   for e in report.errors)
+
+    def test_launch_budget_exhaustion(self, tmp_path):
+        plans = [{"rc": 0}]  # exits clean, never makes progress
+        orch, _ckpt = _orchestrator(tmp_path, plans, [8], max_launches=3)
+        report = orch.run()
+        assert not report.completed
+        assert len(report.launches) == 3
+        assert all(l["outcome"] == "drained" for l in report.launches)
+        assert any("did not reach step" in e for e in report.errors)
+
+
+class TestFleetFlights:
+    def _flight(self, d, name, cause, gen):
+        (d / name).write_text(json.dumps(
+            {"cause": cause, "fleet_generation": gen}))
+
+    def test_one_flight_per_abnormal_exit(self, tmp_path):
+        self._flight(tmp_path, "flight_1_0.json",
+                     "FaultError: injected crash@step=6 "
+                     "[fleet gen=0 rank=0]", "0")
+        self._flight(tmp_path, "flight_2_0.json",
+                     "preemption (sigterm) drained at epoch 2 step 2 "
+                     "[fleet gen=1 rank=0]", "1")
+        launches = [
+            {"generation": 0, "outcome": "crashed"},
+            {"generation": 1, "outcome": "drained"},
+            {"generation": 2, "outcome": "completed"},
+        ]
+        stats = check_fleet_flights(tmp_path, launches)
+        assert stats["flights_ok"] is True
+        assert stats["flight_problems"] == []
+
+    def test_missing_and_surplus_flights_flag(self, tmp_path):
+        self._flight(tmp_path, "flight_3_0.json",
+                     "stray [fleet gen=2 rank=0]", "2")
+        launches = [
+            {"generation": 0, "outcome": "crashed"},   # no flight: bad
+            {"generation": 2, "outcome": "completed"},  # flight: bad
+        ]
+        stats = check_fleet_flights(tmp_path, launches)
+        assert stats["flights_ok"] is False
+        assert len(stats["flight_problems"]) == 2
+
+    def test_pre_existing_flights_are_ignored(self, tmp_path):
+        """A reused --ckpt-dir's stale postmortems (a previous fleet run)
+        must neither satisfy nor fail THIS run's accounting — the same
+        guard the chaos harness applies."""
+        stale = tmp_path / "flight_0_0.json"
+        self._flight(tmp_path, "flight_0_0.json",
+                     "old crash [fleet gen=0 rank=0]", "0")
+        launches = [{"generation": 0, "outcome": "completed"}]
+        # without the exclusion the completed gen-0 'left' a flight: bad
+        assert check_fleet_flights(tmp_path, launches)["flights_ok"] \
+            is False
+        stats = check_fleet_flights(tmp_path, launches, ignore={stale})
+        assert stats["flights_ok"] is True and stats["flights"] == []
+
+    def test_drained_flight_must_name_preemption(self, tmp_path):
+        self._flight(tmp_path, "flight_4_0.json",
+                     "something else [fleet gen=0 rank=0]", "0")
+        stats = check_fleet_flights(
+            tmp_path, [{"generation": 0, "outcome": "drained"}])
+        assert stats["flights_ok"] is False
+        assert "not a preemption" in stats["flight_problems"][0]
+
+
+def test_fleet_command_registered():
+    """`resilience fleet` parses (the console-script surface) and the
+    orchestrator module is importable without jax initialized."""
+    import distributed_pytorch_training_tpu.resilience.fleet as fleet_mod
+
+    assert callable(fleet_mod.fleet_main)
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    # unknown option after the command must be a usage error, proving the
+    # subcommand is wired into the entry point's parser
+    with pytest.raises(SystemExit):
+        main(["fleet", "--no-such-option"])
+
+
+@pytest.mark.slow
+def test_fleet_cli_e2e_kill_shrink_grow_bitwise(tmp_path, capsys):
+    """ISSUE-12 acceptance: the real train.py fleet — a zero1 child
+    killed at full world, relaunched at half world (cross-world restore
+    through train.py's elastic --resume: raw restore + reshard, flat
+    moments re-sliced), drained by SIGTERM, relaunched at full world on
+    capacity return, completing with the final checkpoint BITWISE equal
+    to an uninterrupted control child continuing from the last handoff.
+    One attributable flight per abnormal child exit; zero
+    CheckpointWorldSizeMismatch escapes."""
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    rc = main(["fleet", "--layout", "zero1",
+               "--ckpt-dir", str(tmp_path), "--json"])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert stats["completed"] is True
+    assert stats["parity_bitwise"] is True
+    assert stats["mismatch_escapes"] == 0
+    assert stats["worlds"] == [8, 4, 8]
+    assert [l["outcome"] for l in stats["launches"]] == \
+        ["crashed", "drained", "completed"]
+    assert stats["flights_ok"] is True
+    causes = [f["cause"] or "" for f in stats["flights"]]
+    assert any("crash@step" in c and "[fleet gen=0" in c for c in causes)
+    assert any("preemption" in c and "[fleet gen=1" in c for c in causes)
+    # both cross-world restores rode the elastic resume path
+    logs = sorted((Path(stats["dir"]) / "ckpt" /
+                   "fleet_logs").glob("gen*.log"))
+    resumed = [p.read_text(errors="replace") for p in logs[1:]]
+    assert all("ELASTIC RESUME" in t for t in resumed)

@@ -154,6 +154,34 @@ class TestFaultPlan:
         with pytest.raises(ValueError, match="repeat count"):
             FaultPlan.parse("crash@step=3x0")
 
+    def test_capacity_return_parses_and_notifies_watch(self):
+        """ISSUE-12: capacity_return@step=k is a non-raising fault — it
+        credits the armed CapacityWatch back to the full registry at the
+        step fence and records itself in `fired` like any other fault."""
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch,
+        )
+
+        watch = CapacityWatch(total=8, available=5)
+        inj = FaultInjector(FaultPlan.parse("capacity_return@step=2"),
+                            log=lambda _m: None, capacity_watch=watch)
+        inj.on_step(1)
+        assert watch.available() == 5
+        inj.on_step(2)  # no raise: capacity coming back is not a failure
+        assert watch.available() == 8
+        assert watch.returned.is_set()
+        inj.on_step(2)  # spent
+        assert inj.fired == ["capacity_return@step=2"]
+        assert inj.unfired() == []
+
+    def test_capacity_return_without_watch_is_harmless(self):
+        logs = []
+        inj = FaultInjector(FaultPlan.parse("capacity_return@step=0"),
+                            log=logs.append)
+        inj.on_step(0)
+        assert inj.fired == ["capacity_return@step=0"]
+        assert any("no CapacityWatch" in m for m in logs)
+
     def test_loader_stall_sleeps_once(self):
         inj = FaultInjector(FaultPlan.parse("loader_stall@step=1:0.15s"),
                             log=lambda _m: None)
@@ -385,6 +413,57 @@ class TestAsyncSave:
         mgr.close()
         assert restored is not None and restored[1] == 2
         assert "never finalized" in mgr.verify(1)  # the lost save is torn
+
+
+# ---------------------------------------------------------------------------
+# CapacityWatch: the grow-side registry (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityWatch:
+    def _watch(self, **kw):
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch,
+        )
+
+        return CapacityWatch(**kw)
+
+    def test_lose_restore_sync_bounds(self):
+        w = self._watch(total=8)
+        assert w.available() == 8
+        assert w.lose(3) == 5
+        assert w.lose(99) == 0     # floor at zero, never negative
+        assert w.restore(2) == 2
+        assert w.restore() == 8    # None = back to full
+        assert w.restore(99) == 8  # ceiling at total
+        assert w.sync(3) == 3      # absolute (the death-restart path)
+        assert w.sync(99) == 8     # clamped both ways
+        assert w.sync(-1) == 0
+
+    def test_poll_grow_only_above_current_world(self):
+        w = self._watch(total=8, available=4)
+        assert w.poll_grow(4) is None      # nothing returned yet
+        assert w.poll_grow(None) is None   # unknown world: never grow
+        w.restore()
+        assert w.poll_grow(4) == 8
+        assert not w.returned.is_set()     # poll consumes the hint
+        assert w.poll_grow(8) is None      # already at capacity
+
+    def test_probe_feed_syncs_available(self):
+        feed = {"n": 3}
+        w = self._watch(total=8, probe=lambda: feed["n"])
+        assert w.available() == 3
+        feed["n"] = 12                     # clamped to the registry total
+        assert w.available() == 8
+        assert w.returned.is_set()
+
+    def test_validation_is_loud(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match=">= 1 replica"):
+            self._watch(total=0)
+        with _pytest.raises(ValueError, match="must lie in"):
+            self._watch(total=4, available=9)
 
 
 # ---------------------------------------------------------------------------
@@ -660,13 +739,194 @@ class TestSupervisor:
         assert report.completed and report.restarts == 1
         assert report.resizes == [{"from_world": 8, "to_world": 4,
                                    "survivors": 7, "label": None,
-                                   "epoch": 0, "step": 0}]
+                                   "epoch": 0, "step": 0,
+                                   "direction": "shrink"}]
         assert int(state.step) == 4  # the full epoch ran at world 4
         flights = sorted(tmp_path.glob("flight_*.json"))
         assert len(flights) == 1
         assert "replica_death@step=1" in flights[0].read_text()
         expect = _FAST_RETRY.delay_s(1, random.Random(_FAST_RETRY.seed))
         assert sleeps == [expect]  # jitter stays deterministic
+
+    def test_retry_budget_resets_after_clean_segment(self, rig, tmp_path):
+        """ISSUE-12 satellite: two isolated faults separated by clean
+        segments must BOTH restart at consecutive-attempt 1 — max_restarts
+        bounds consecutive failures, not lifetime faults. max_restarts=1
+        here: before the reset existed, the second fault pushed the
+        lifetime counter to 2 > 1 and a perfectly recoverable run died."""
+        import random
+
+        trainer, state_factory, make_loader = rig
+        inj = FaultInjector(FaultPlan.parse("crash@step=1,crash@step=5"),
+                            log=lambda _m: None)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 post_save_hook=inj.on_save)
+        retry = RetryPolicy(max_restarts=1, backoff_base_s=0.01,
+                            backoff_max_s=0.02, seed=0)
+        sleeps = []
+        sup = Supervisor(trainer, ckpt, state_factory,
+                         make_loader(inj.on_loader_batch),
+                         retry=retry, injector=inj,
+                         checkpoint_every_steps=2, sleep=sleeps.append)
+        state, report = sup.run(epochs=2)
+        ckpt.close()
+        assert report.completed and report.restarts == 2
+        assert report.faults_fired == ["crash@step=1", "crash@step=5"]
+        # both backoffs are ATTEMPT-1 delays (the exponent reset with the
+        # budget); the jitter stream still advances deterministically
+        rng = random.Random(retry.seed)
+        assert sleeps == [retry.delay_s(1, rng), retry.delay_s(1, rng)]
+        assert int(state.step) == 8
+        control = _control_params(trainer, state_factory, make_loader(), 2)
+        _assert_bitwise_equal(state.params, control.params)
+
+    def test_supervisor_grows_at_segment_boundary(self, rig, tmp_path):
+        """ISSUE-12 tentpole: capacity returning mid-segment grows the
+        run at the NEXT segment boundary — no restart, no replay, no
+        flight; the resize record anchors on the boundary checkpoint and
+        the run finishes at the grown world."""
+        from distributed_pytorch_training_tpu import telemetry
+        from distributed_pytorch_training_tpu.parallel import (
+            MeshSpec, build_mesh,
+        )
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            _build_rig,
+        )
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch,
+        )
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            ElasticPlan, plan_elastic_world,
+        )
+
+        mesh4 = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        # the run STARTS shrunken (world 4, per-device batch 4) — the
+        # fleet lost half its replicas before this process launched
+        t4, sf4, l4 = _build_rig(mesh4, seed=0, dataset_size=64,
+                                 per_device_batch=4)
+        trainer8, state_factory8, make_loader = rig
+        watch = CapacityWatch(total=8, available=4)
+        inj = FaultInjector(FaultPlan.parse("capacity_return@step=1"),
+                            log=lambda _m: None, capacity_watch=watch)
+        worlds_asked = []
+
+        def replan(available):
+            worlds_asked.append(available)
+            world = plan_elastic_world(available, 16)
+            assert world == 8
+            return ElasticPlan(trainer=trainer8,
+                               loader=make_loader(inj.on_loader_batch),
+                               state_factory=state_factory8, world=8)
+
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"))
+        telemetry.configure(str(tmp_path / "telemetry.jsonl"))
+        try:
+            sup = Supervisor(t4, ckpt, sf4, l4, retry=_FAST_RETRY,
+                             injector=inj, checkpoint_every_steps=2,
+                             replan_cb=replan, capacity_watch=watch)
+            state, report = sup.run(epochs=1)
+            events = telemetry.get().tail(512)
+        finally:
+            telemetry.reset()
+            ckpt.close()
+        assert report.completed and report.restarts == 0
+        assert report.resizes == [{"from_world": 4, "to_world": 8,
+                                   "survivors": 8, "label": 2,
+                                   "epoch": 0, "step": 2,
+                                   "direction": "grow"}]
+        assert worlds_asked == [8]
+        assert int(state.step) == 4
+        assert not list(tmp_path.glob("flight_*.json"))  # a grow is not
+        # an abnormal exit
+        names = [e["name"] for e in events if e["kind"] == "span"]
+        assert "elastic_grow" in names and "capacity_watch" in names
+
+    def test_grow_skipped_when_no_larger_world_is_feasible(self, rig,
+                                                           tmp_path):
+        """Capacity returning in a quantity no feasible world can use
+        (6 available, global batch 16 -> largest divisor is still 4)
+        must keep the run at its current world, resize-free."""
+        from distributed_pytorch_training_tpu.parallel import (
+            MeshSpec, build_mesh,
+        )
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            _build_rig,
+        )
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch,
+        )
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            ElasticPlan, plan_elastic_world,
+        )
+
+        mesh4 = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        t4, sf4, l4 = _build_rig(mesh4, seed=0, dataset_size=64,
+                                 per_device_batch=4)
+        # only 6 replicas ever exist: restore() tops out at 6, whose
+        # largest batch-dividing world is still 4
+        watch = CapacityWatch(total=6, available=4)
+        inj = FaultInjector(FaultPlan.parse("capacity_return@step=1"),
+                            log=lambda _m: None, capacity_watch=watch)
+
+        def replan(available):
+            world = plan_elastic_world(available, 16)
+            return ElasticPlan(trainer=t4, loader=l4, state_factory=sf4,
+                               world=world)
+
+        sup = Supervisor(t4, None, sf4, l4, retry=_FAST_RETRY,
+                         injector=inj, checkpoint_every_steps=2,
+                         replan_cb=replan, capacity_watch=watch)
+        state, report = sup.run(epochs=1)
+        assert report.completed and report.resizes == []
+        assert int(state.step) == 4
+
+    def test_grow_deferred_when_anchor_save_is_lost(self, rig, tmp_path):
+        """A grow must anchor on a DURABLE checkpoint: when the boundary
+        save's async write fails, the grow is deferred (recorded in
+        failures, no resize), the torn label is skipped by later
+        restores, and the run still completes at the original world."""
+        from distributed_pytorch_training_tpu.parallel import (
+            MeshSpec, build_mesh,
+        )
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            _build_rig,
+        )
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch,
+        )
+        from distributed_pytorch_training_tpu.resilience.elastic import (
+            ElasticPlan,
+        )
+
+        mesh4 = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        t4, sf4, l4 = _build_rig(mesh4, seed=0, dataset_size=64,
+                                 per_device_batch=4)
+        trainer8, state_factory8, make_loader = rig
+        watch = CapacityWatch(total=8, available=4)
+        inj = FaultInjector(FaultPlan.parse("capacity_return@step=1"),
+                            log=lambda _m: None, capacity_watch=watch)
+        armed = {"on": True}
+
+        def lose_first_save(_label):
+            if armed["on"]:
+                armed["on"] = False
+                raise RuntimeError("disk gone under the anchor")
+
+        def replan(available):
+            return ElasticPlan(trainer=trainer8, loader=make_loader(),
+                               state_factory=state_factory8, world=8)
+
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 pre_finalize_hook=lose_first_save)
+        sup = Supervisor(t4, ckpt, sf4, l4, retry=_FAST_RETRY,
+                         injector=inj, checkpoint_every_steps=2,
+                         replan_cb=replan, capacity_watch=watch)
+        state, report = sup.run(epochs=1)
+        ckpt.close()
+        assert report.completed and report.resizes == []
+        assert any("grow deferred" in f for f in report.failures)
+        assert "never finalized" in ckpt.verify(2)  # the lost anchor
+        assert int(state.step) == 4  # finished at world 4, undisturbed
 
     def test_retry_policy_backoff_is_bounded_and_jittered(self):
         import random
@@ -712,6 +972,26 @@ def test_chaos_cli_recovers_and_verifies_parity(tmp_path, capsys):
                for f in stats["flights"])
 
 
+def test_chaos_cli_fixed_world_capacity_return_is_harmless(tmp_path,
+                                                           capsys):
+    """A capacity_return fault in a FIXED-world schedule (no --elastic,
+    no watch) fires into the void by design — a fully-recovered run must
+    still be scored RECOVERED (the grow requirement binds only under
+    --elastic)."""
+    from distributed_pytorch_training_tpu.resilience.__main__ import main
+
+    rc = main(["chaos", "--chaos", "crash@step=2,capacity_return@step=3",
+               "--epochs", "1", "--checkpoint-every-steps", "2",
+               "--max-restarts", "2",
+               "--ckpt-dir", str(tmp_path / "ckpt"), "--json"])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert stats["completed"] and stats["parity_bitwise"] is True
+    assert stats["faults_fired"] == ["crash@step=2",
+                                     "capacity_return@step=3"]
+    assert stats["resizes"] == []
+
+
 def _chaos_elastic(tmp_path, capsys, *extra):
     from distributed_pytorch_training_tpu.resilience.__main__ import main
 
@@ -721,23 +1001,29 @@ def _chaos_elastic(tmp_path, capsys, *extra):
     return rc, stats
 
 
-def test_chaos_cli_elastic_resize_bitwise_parity(tmp_path, capsys):
-    """ISSUE-11 acceptance (the tier-1 elastic smoke): replica_death
-    mid-epoch under `resilience chaos --elastic` — the run re-plans
-    8 -> 4 replicas (7 survivors; 4 is the largest divisor of the fixed
-    global batch), reshards the checkpoint, completes, records the resize
-    in the RunReport, leaves a replica_death flight, and the post-resize
-    segment is BITWISE equal to a clean same-seed continuation at the
-    shrunken world."""
+def test_chaos_cli_elastic_bidirectional_bitwise_parity(tmp_path, capsys):
+    """ISSUE-11 + ISSUE-12 acceptance (the tier-1 elastic smoke): the
+    default `resilience chaos --elastic` schedule is now BIDIRECTIONAL —
+    replica_death mid-epoch shrinks 8 -> 4 (7 survivors; 4 is the largest
+    divisor of the fixed global batch), capacity_return at the step-4
+    fence grows it back 4 -> 8 at the next segment boundary (one run, one
+    restart, zero restarts for the grow), both resizes are recorded with
+    their anchor checkpoints, the death leaves its flight, and the
+    post-GROW segment is BITWISE equal to a clean same-seed continuation
+    at the full world (restore the grow-anchor label at its recorded
+    world, reshard, run the remainder clean)."""
     rc, stats = _chaos_elastic(tmp_path, capsys)
     assert rc == 0
     assert stats["completed"] is True
     assert stats["parity_bitwise"] is True
     assert stats["restarts"] == 1
-    assert stats["faults_fired"] == ["replica_death@step=3"]
-    assert stats["resizes"] == [{"from_world": 8, "to_world": 4,
-                                 "survivors": 7, "label": 2,
-                                 "epoch": 0, "step": 2}]
+    assert stats["faults_fired"] == ["replica_death@step=3",
+                                     "capacity_return@step=4"]
+    assert stats["resizes"] == [
+        {"from_world": 8, "to_world": 4, "survivors": 7, "label": 2,
+         "epoch": 0, "step": 2, "direction": "shrink"},
+        {"from_world": 4, "to_world": 8, "survivors": 8, "label": 6,
+         "epoch": 1, "step": 2, "direction": "grow"}]
     assert stats["flights_ok"] is True
     assert any("replica_death" in (f["cause"] or "")
                for f in stats["flights"])

@@ -109,6 +109,40 @@ class TestFlightRecorder:
         assert events[-1]["kind"] == "exit" \
             and events[-1]["flight_path"] == str(p)
 
+    def test_flight_carries_fleet_generation_and_rank(self, tmp_path,
+                                                      monkeypatch):
+        """ISSUE-12 satellite: a fleet-orchestrated child's flights carry
+        the launch generation + rank (from the env resilience/fleet.py
+        stamps) both in the CAUSE — '[fleet gen=2 rank=0]', the first
+        thing a reader sees — and as structured fields the fleet's flight
+        accounting keys on."""
+        from distributed_pytorch_training_tpu.telemetry.flight import (
+            FLEET_GENERATION_ENV, FLEET_RANK_ENV,
+        )
+
+        monkeypatch.setenv(FLEET_GENERATION_ENV, "2")
+        monkeypatch.setenv(FLEET_RANK_ENV, "0")
+        p = telemetry.flush_flight("FaultError: injected crash@step=6",
+                                   directory=str(tmp_path), rc=1)
+        body = json.loads(Path(p).read_text())
+        assert body["cause"] == ("FaultError: injected crash@step=6 "
+                                 "[fleet gen=2 rank=0]")
+        assert body["fleet_generation"] == "2"
+        assert body["fleet_rank"] == "0"
+
+    def test_flight_without_fleet_env_is_unstamped(self, tmp_path,
+                                                   monkeypatch):
+        from distributed_pytorch_training_tpu.telemetry.flight import (
+            FLEET_GENERATION_ENV, FLEET_RANK_ENV,
+        )
+
+        monkeypatch.delenv(FLEET_GENERATION_ENV, raising=False)
+        monkeypatch.delenv(FLEET_RANK_ENV, raising=False)
+        p = telemetry.flush_flight("plain", directory=str(tmp_path))
+        body = json.loads(Path(p).read_text())
+        assert body["cause"] == "plain"
+        assert "fleet_generation" not in body
+
     def test_two_flights_never_collide(self, tmp_path):
         telemetry.configure(str(tmp_path / "t.jsonl"))
         a = telemetry.flush_flight("one")
@@ -272,6 +306,23 @@ class TestCli:
         assert "compile" not in split          # no double-count
         assert "unaccounted" not in split      # phases close to 100 exactly
         assert s["spans"]["compile"]["total_ms"] == 300.0  # still visible
+
+    def test_grow_and_capacity_spans_are_bucketed(self):
+        """ISSUE-12 satellite: the grow-side phases — `elastic_grow` (the
+        live M->N reshard) and `capacity_watch` (the Supervisor's
+        boundary polls) — are canonical phases in the named split, not
+        'unaccounted'."""
+        events = [
+            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
+            {"kind": "span", "name": "elastic_grow", "dur_ms": 400.0},
+            {"kind": "span", "name": "capacity_watch", "dur_ms": 50.0},
+            {"kind": "span", "name": "capacity_watch", "dur_ms": 50.0},
+            {"kind": "span", "name": "step_dispatch", "dur_ms": 500.0},
+        ]
+        split = summarize(events)["step_split_pct"]
+        assert split["elastic_grow"] == 40.0
+        assert split["capacity_watch"] == 10.0  # both polls summed
+        assert "unaccounted" not in split
 
     def test_torn_stream_still_summarizes(self, tmp_path):
         p = self._stream(tmp_path)

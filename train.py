@@ -586,11 +586,39 @@ def _run(args, guard):
             try:
                 restored = ckpt.restore_latest(
                     state, template_world_size=n_batch_shards)
-            except CheckpointWorldSizeMismatch:
-                # already a precise, named diagnosis (both world sizes in
-                # the message) — the generic mesh-hint wrapper below would
-                # only bury it
-                raise
+            except CheckpointWorldSizeMismatch as mismatch:
+                # Cross-PROCESS elastic resume (ISSUE 12): a fleet
+                # relaunch at a different world size lands here — the
+                # flat-padded layouts (zero1 moments, fsdp params, EF
+                # residuals) changed shape with the DP degree. Restore
+                # the newest valid checkpoint RAW (its own saved shapes
+                # are the old-world template; this process cannot build
+                # device templates for a mesh it doesn't have) and
+                # reshard the host arrays into this run's layout. The
+                # named error escapes only when there is genuinely
+                # nothing reshardable (no valid checkpoint / no recorded
+                # world — a foreign directory, not an elastic relaunch).
+                known = getattr(mismatch, "label", None)
+                raw = ckpt.restore_latest_raw(
+                    among=None if known is None else {known})
+                if raw is None or raw[2] is None:
+                    raise
+                from distributed_pytorch_training_tpu.resilience.elastic \
+                    import reshard_raw_state
+                arrays, label, saved_world, r_epoch, r_step = raw
+                with telemetry.span("elastic_reshard",
+                                    from_world=saved_world,
+                                    to_world=n_batch_shards, label=label,
+                                    cross_process=True):
+                    state = reshard_raw_state(arrays, saved_world,
+                                              n_batch_shards, trainer,
+                                              state)
+                restored = (state, r_epoch, r_step)
+                log_main(f"ELASTIC RESUME: checkpoint {label} was laid "
+                         f"out for world size {saved_world}; resharded "
+                         f"to {n_batch_shards} (flat-padded re-slice + "
+                         "EF row fold — sampler/step-fence/RNG schedule "
+                         "unchanged)")
             except Exception as e:
                 # Param SHAPES depend on the TP layout (vocab padding is
                 # lcm(128, model-axis)): resuming under a different --mesh
