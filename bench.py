@@ -158,6 +158,19 @@ EXTRA_CONFIGS = (
     ("gpt2_355m_fsdp", "gpt2_355m", 420,
      dict(per_device_batch=2, seq_len=1024, steps=6,
           grad_sync=dict(fsdp_explicit=True))),
+    # Explicit TP x FSDP on the 2-D ("data","model") mesh (ISSUE 13): the
+    # BASELINE flagship with megatron column/row-split blocks + the
+    # vocab-parallel embedding inside the FSDP shard_map — params + AdamW
+    # moments at rest 1/(N*M) for TP-split tensors, per-layer
+    # gather/scatter wire 1/M per replica, one model-axis psum per
+    # residual join. Rows carry tp_psum_bytes_per_replica next to the
+    # data-axis terms and the tp-psum-signature contract verdict. Needs
+    # >= 2 chips (model=2 on one device fails the mesh build loudly; the
+    # per-config guard records the skip).
+    ("gpt2_355m_fsdp_tp", "gpt2_355m", 420,
+     dict(per_device_batch=2, seq_len=1024, steps=6,
+          grad_sync=dict(fsdp_explicit=True),
+          mesh_spec="data=-1,model=2")),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can

@@ -150,6 +150,10 @@ class Contract:
     min_elements: int = 128
     min_shards: int = 1
     kind: str = "train"
+    # Mesh the contract lowers on: "" = the default pure-DP mesh over all
+    # local devices; the explicit TP x FSDP contracts (ISSUE 13) name a
+    # 2-D spec ("data=4,model=2") parsed by parallel.mesh.MeshSpec.
+    mesh_spec: str = ""
 
 
 # The canonical matrix (ISSUE 3): dp, zero1, grad_sync x wire dtypes,
@@ -220,6 +224,25 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "fp32, per-layer census unchanged",
              config=dict(fsdp_explicit=True, wire_dtype="int8_multihop"),
              min_shards=2),
+    # Explicit TP x FSDP on the 2-D ("data","model") mesh (ISSUE 13): the
+    # tp-psum-signature budget binds (one megatron psum per residual join
+    # + backward mirrors + the vocab-parallel embedding pair, one logits
+    # gather), every param gather/scatter rides the data axes only
+    # (fsdp-gather-rides-data-only), the per-layer gather/scatter census
+    # holds over the TP-LOCAL layer plan, and no gradient-sized all-reduce
+    # survives off the model axis. No existing rule is relaxed: 1-D
+    # artifacts never consult the axis classifier.
+    Contract("fsdp_tp",
+             "explicit megatron TP x FSDP on data=4,model=2: model-axis "
+             "psum budget + data-axis-only param wire, exact fp32",
+             config=dict(fsdp_explicit=True), min_shards=2,
+             mesh_spec="data=4,model=2"),
+    Contract("fsdp_tp_int8_mh",
+             "explicit TP x FSDP fully compressed: s8 data-axis gradient "
+             "scatter (EF per model shard) + s8 data-axis param gathers; "
+             "model-axis activation psums stay exact fp32 by design",
+             config=dict(fsdp_explicit=True, wire_dtype="int8_multihop"),
+             min_shards=2, mesh_spec="data=4,model=2"),
     # The serving decode-step contract (ISSUE 10): the inference engine's
     # one-token KV-cache step must carry NO host transfers (a callback in
     # the decode loop stalls every generated token) and must DONATE the

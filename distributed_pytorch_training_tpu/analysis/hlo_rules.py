@@ -42,6 +42,17 @@ _HLO_COLLECTIVE_RE = re.compile(
     r"|ragged-all-to-all|all-to-all)"
     r"(-start|-done)?[.\w]*\(")
 
+# The collective's device grouping, printed on the same HLO line: the
+# explicit form `replica_groups={{0,1},{2,3}}` or the iota form
+# `replica_groups=[G,S]<=[dims...]` with an optional transpose suffix
+# `T(perm)` (XLA's strided-group print form — the data-axis groups of a
+# (data, model) mesh). The capture must accept every shape
+# `parse_replica_groups` can decode, or classifiable groups silently
+# arrive as "" and the TP rules misfire.
+_REPLICA_GROUPS_RE = re.compile(
+    r"replica_groups="
+    r"(\{\{[\d,{} ]*\}\}|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)")
+
 # One array shape inside an HLO result: "f32[1000,512]{1,0}" (possibly inside
 # a tuple). Captures the bracketed dims; "f32[]" is a scalar.
 _HLO_SHAPE_RE = re.compile(r"\w+\[([\d,]*)\]")
@@ -70,21 +81,90 @@ def hlo_result_elements(shape_str: str) -> int:
 
 
 def collective_census(compiled_text: str) -> List[dict]:
-    """Census of collective ops in optimized HLO text: op kind + result shape.
+    """Census of collective ops in optimized HLO text: op kind + result
+    shape + the replica grouping (which mesh axis the collective rides —
+    the 2-D TP x FSDP rules classify it via `replica_group_axis`).
 
     The static half of the grad-sync analysis: what the compiler actually
     scheduled (names/shapes straight from the executable), standing in for
     the reference's promised profiler-timeline read-off (README.md:35)."""
     rows = {}
-    for m in _HLO_COLLECTIVE_RE.finditer(compiled_text):
+    for line in compiled_text.splitlines():
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
         shape, kind, suffix = m.group(1), m.group(2), m.group(3)
         if suffix == "-done":
             continue  # the paired completion of an async -start
-        key = (kind, shape)
+        g = _REPLICA_GROUPS_RE.search(line)
+        groups = g.group(1) if g else ""
+        key = (kind, shape, groups)
         if key not in rows:
-            rows[key] = {"op": kind, "result_shape": shape, "count": 0}
+            rows[key] = {"op": kind, "result_shape": shape,
+                         "replica_groups": groups, "count": 0}
         rows[key]["count"] += 1
-    return sorted(rows.values(), key=lambda r: (r["op"], r["result_shape"]))
+    return sorted(rows.values(),
+                  key=lambda r: (r["op"], r["result_shape"],
+                                 r["replica_groups"]))
+
+
+def parse_replica_groups(groups: str):
+    """Explicit `{{0,1},{2,3}}` or iota `[G,S]<=[dims...]` replica groups
+    (with an optional transpose suffix `T(perm)` — XLA's strided-group
+    print form, e.g. the data-axis groups of a (data, model) mesh) as a
+    tuple of tuples; None when absent/unparseable."""
+    if not groups:
+        return None
+    if groups.startswith("{{"):
+        try:
+            return tuple(
+                tuple(int(x) for x in part.split(",") if x.strip())
+                for part in groups.strip("{}").split("},{"))
+        except ValueError:
+            return None
+    m = re.fullmatch(r"\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+                     groups)
+    if m:
+        import numpy as _np
+
+        n_groups, size = int(m.group(1)), int(m.group(2))
+        dims = tuple(int(d) for d in m.group(3).split(","))
+        total = int(_np.prod(dims))
+        if n_groups * size != total:
+            return None
+        devices = _np.arange(total).reshape(dims)
+        if m.group(4) is not None:
+            perm = tuple(int(p) for p in m.group(4).split(","))
+            if sorted(perm) != list(range(len(dims))):
+                return None
+            devices = devices.transpose(perm)
+        flat = devices.reshape(-1)
+        return tuple(tuple(int(x) for x in flat[g * size:(g + 1) * size])
+                     for g in range(n_groups))
+    return None
+
+
+def replica_group_axis(groups: str, n_batch: int, n_model: int) -> str:
+    """Which logical axis a collective's replica groups ride, on a 2-D
+    (batch-shards x model) device layout with the model axis MINOR
+    (parallel/mesh.AXIS_ORDER puts `model` last): "model" (consecutive-id
+    groups of size M), "data" (stride-M groups of size N), "all" (one
+    group spanning every device), or "other"/"unknown". The TP x FSDP
+    rules use this to tell megatron activation psums from gradient
+    traffic; artifacts without a model axis never consult it."""
+    parsed = parse_replica_groups(groups)
+    if parsed is None:
+        return "unknown"
+    got = {frozenset(g) for g in parsed}
+    total = n_batch * n_model
+    if got == {frozenset(range(b * n_model, (b + 1) * n_model))
+               for b in range(n_batch)}:
+        return "model"
+    if got == {frozenset(range(m, total, n_model)) for m in range(n_model)}:
+        return "data"
+    if got == {frozenset(range(total))}:
+        return "all"
+    return "other"
 
 
 def weight_update_census(compiled_text: str, min_elements: int = 8192) -> dict:
@@ -209,10 +289,34 @@ class StepArtifacts:
     # present: Pallas emits a custom-call on TPU but inlines as plain HLO
     # in CPU interpreter mode) abstain rather than guess when it is "".
     backend: str = ""
+    # Explicit TP x FSDP (ISSUE 13): the mesh's model-axis size (1 = no
+    # TP — every pre-existing artifact), and the trainer-derived model-axis
+    # collective budget: `tp_expected_psums` counts the megatron psums of
+    # one fwd+bwd step (one per residual join forward + its backward
+    # mirror at each parallel-region input: 4/block, +2 with the
+    # vocab-parallel embedding), `tp_expected_model_gathers` the
+    # vocab-parallel logits gathers (1 when engaged). Snapshotted from the
+    # trainer (Trainer.tp_expected_model_collectives), never hard-coded in
+    # a rule.
+    model_shards: int = 1
+    tp_expected_psums: int = 0
+    tp_expected_model_gathers: int = 0
 
     @property
     def wire_mode(self) -> str:
         return self.config.get("wire_dtype", "fp32")
+
+    @property
+    def tp_engaged(self) -> bool:
+        """Mirrors Trainer's engagement condition for explicit TP x FSDP."""
+        return bool(self.config.get("fsdp_explicit")) and self.model_shards > 1
+
+    def collective_axis(self, row: dict) -> str:
+        """`replica_group_axis` of one census row under this artifact's
+        (batch, model) shard counts."""
+        return replica_group_axis(row.get("replica_groups", ""),
+                                  max(self.n_shards, 1),
+                                  max(self.model_shards, 1))
 
     @property
     def zero1_engaged(self) -> bool:
@@ -345,7 +449,14 @@ def check_no_fp32_wire(a: StepArtifacts) -> List[Finding]:
     if a.preopt_text is None:
         return []  # no reliable wire read — see check_compressed_wire
     census = grad_sync_census(a.wire_text, a.min_elements)
-    bad = [r for r in census["rows"]
+    # Explicit TP: megatron activation psums ride the MODEL axis in exact
+    # fp32 BY DESIGN (they are forward/backward activations, not gradient
+    # sync — the zero1 param-gather exemption's argument); only collectives
+    # off the model axis must keep the compressed-wire promise.
+    rows = census["rows"]
+    if a.tp_engaged:
+        rows = [r for r in rows if a.collective_axis(r) != "model"]
+    bad = [r for r in rows
            if r["op"] in _REDUCTION_KINDS and "f32" in r["dtypes"]]
     if bad:
         return [Finding(
@@ -432,12 +543,21 @@ def check_fsdp_gather_bound(a: StepArtifacts) -> List[Finding]:
     # floor are invisible by design, so the expectation is floor-aware.
     expected = sum(1 for s in sizes if s >= a.min_elements)
     census = grad_sync_census(a.optimized_text, a.min_elements)
-    gathers = census["by_op"].get("all-gather", 0)
+    if a.tp_engaged:
+        # 2-D mesh: count only the DATA-axis gathers — the vocab-parallel
+        # logits gather rides the model axis and is tp-psum-signature's
+        # budget, not a param gather
+        gathers = sum(r["count"] for r in census["rows"]
+                      if r["op"] == "all-gather"
+                      and a.collective_axis(r) == "data")
+    else:
+        gathers = census["by_op"].get("all-gather", 0)
     if gathers != expected:
         return [Finding(
             "fsdp-layer-gather-bound",
             f"fsdp step carries {gathers} gradient/param-sized "
-            f"all-gather(s), expected exactly {expected} (one per layer "
+            + ("data-axis " if a.tp_engaged else "")
+            + f"all-gather(s), expected exactly {expected} (one per layer "
             f"group over the census floor; {len(sizes)} group(s), "
             f"{len(sizes) - expected} under min_elements="
             f"{a.min_elements}): {census['by_op']}", a.name)]
@@ -458,7 +578,21 @@ def check_fsdp_scatter_signature(a: StepArtifacts) -> List[Finding]:
     census = grad_sync_census(a.optimized_text, a.min_elements)
     by_op = census["by_op"]
     out = []
-    scatters = by_op.get("reduce-scatter", 0) + by_op.get("all-to-all", 0)
+    if a.tp_engaged:
+        # 2-D mesh: the scatter census counts data-axis collectives; the
+        # model-axis megatron psums are all-reduces by op kind and are
+        # budgeted by tp-psum-signature instead — a gradient-sized
+        # all-reduce on the DATA axes is still the violation here.
+        rows = census["rows"]
+        scatters = sum(r["count"] for r in rows
+                       if r["op"] in ("reduce-scatter", "all-to-all")
+                       and a.collective_axis(r) == "data")
+        data_all_reduce = sum(r["count"] for r in rows
+                              if r["op"] == "all-reduce"
+                              and a.collective_axis(r) != "model")
+    else:
+        scatters = by_op.get("reduce-scatter", 0) + by_op.get("all-to-all", 0)
+        data_all_reduce = by_op.get("all-reduce", 0)
     sizes = a.layer_group_padded_sizes
     if sizes:
         # Floor-aware expectation, per wire: the s8 codec's all-to-all
@@ -479,12 +613,94 @@ def check_fsdp_scatter_signature(a: StepArtifacts) -> List[Finding]:
                 f"clears the census floor; {len(sizes)} group(s), "
                 f"min_elements={a.min_elements}, wire={a.wire_mode}): "
                 f"{by_op}", a.name))
-    if by_op.get("all-reduce", 0):
+    if data_all_reduce:
         out.append(Finding(
             "fsdp-scatter-into-shard",
-            f"fsdp step still contains {by_op['all-reduce']} gradient-"
-            "sized all-reduce(s) — gradients are being synced replicated "
+            f"fsdp step still contains {data_all_reduce} gradient-"
+            "sized all-reduce(s)"
+            + (" off the model axis" if a.tp_engaged else "")
+            + " — gradients are being synced replicated "
             "instead of scattered into the shard layout", a.name))
+    return out
+
+
+@rule("tp-psum-signature", "hlo",
+      "explicit TP carries exactly the megatron model-axis collective "
+      "budget: one psum per residual join (+ backward mirror), one "
+      "vocab-parallel logits gather",
+      "the model-axis psums ARE the TP wire: fewer than the budget means "
+      "a parallel region lost its f/g operator (silently wrong gradients "
+      "or a dead region); more means extra model-axis traffic smuggled "
+      "into every step. The budget comes from the trainer's TP model "
+      "(4/block + 2 with the vocab-parallel embedding), never hard-coded "
+      "(parallel/collectives.py copy_to_tp / reduce_from_tp; ISSUE 13).")
+def check_tp_psum_signature(a: StepArtifacts) -> List[Finding]:
+    if not a.tp_engaged:
+        return []
+    if not a.tp_expected_psums:
+        return [Finding(
+            "tp-psum-signature",
+            "explicit-TP config evaluated without a model-axis collective "
+            "budget (tp_expected_psums=0) — the evaluator must snapshot "
+            "Trainer.tp_expected_model_collectives", a.name)]
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    psums = sum(r["count"] for r in census["rows"]
+                if r["op"] == "all-reduce"
+                and a.collective_axis(r) == "model")
+    gathers = sum(r["count"] for r in census["rows"]
+                  if r["op"] == "all-gather"
+                  and a.collective_axis(r) == "model")
+    out = []
+    if psums != a.tp_expected_psums:
+        out.append(Finding(
+            "tp-psum-signature",
+            f"step carries {psums} hidden-sized model-axis all-reduce(s), "
+            f"expected exactly {a.tp_expected_psums} (one per residual "
+            "join forward + its backward mirror per parallel region"
+            + (", +2 for the vocab-parallel embedding"
+               if a.tp_expected_model_gathers else "") + ")", a.name))
+    if gathers != a.tp_expected_model_gathers:
+        out.append(Finding(
+            "tp-psum-signature",
+            f"step carries {gathers} model-axis all-gather(s), expected "
+            f"exactly {a.tp_expected_model_gathers} (the vocab-parallel "
+            "logits gather when the embedding is TP-split)", a.name))
+    return out
+
+
+@rule("fsdp-gather-rides-data-only", "hlo",
+      "under TP x FSDP every param gather/scatter rides the data axes "
+      "only — nothing spans the model axis or the whole mesh",
+      "the 1/M wire reduction IS the composition's win: each model shard "
+      "gathers/scatters only its local parameter slice over its data "
+      "replicas. A collective grouped over (data x model) — or an extra "
+      "model-axis gather beyond the logits budget — means the layout "
+      "regressed to full-parameter traffic while the flag claims the "
+      "division (training/loop.py _fsdp_step; ISSUE 13).")
+def check_fsdp_gather_rides_data_only(a: StepArtifacts) -> List[Finding]:
+    if not a.tp_engaged:
+        return []
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    out = []
+    spanning = [(r["op"], r["result_shape"]) for r in census["rows"]
+                if r["op"] in ("all-gather", "reduce-scatter", "all-to-all")
+                and a.collective_axis(r) in ("all", "other", "unknown")]
+    if spanning:
+        out.append(Finding(
+            "fsdp-gather-rides-data-only",
+            f"{len(spanning)} gradient/param-sized collective(s) ride "
+            f"groups spanning beyond one axis: {spanning[:5]} — the FSDP "
+            "wire must stay on the data axes (model-axis traffic is the "
+            "TP psum/logits budget only)", a.name))
+    model_movers = [(r["op"], r["result_shape"]) for r in census["rows"]
+                    if r["op"] in ("reduce-scatter", "all-to-all")
+                    and a.collective_axis(r) == "model"]
+    if model_movers:
+        out.append(Finding(
+            "fsdp-gather-rides-data-only",
+            f"{len(model_movers)} gradient-sized reduce-scatter/"
+            f"all-to-all(s) ride the MODEL axis: {model_movers[:5]} — "
+            "param/grad movement belongs on the data axes", a.name))
     return out
 
 
@@ -700,7 +916,8 @@ def _elastic_census_findings(a: StepArtifacts, rule_name: str,
     got = collective_census(a.optimized_text)
 
     def keyed(rows):
-        return {(r["op"], r["result_shape"]): r["count"] for r in rows}
+        return {(r["op"], r["result_shape"], r.get("replica_groups", "")):
+                r["count"] for r in rows}
 
     got_k, want_k = keyed(got), keyed(expected)
     if got_k != want_k:
@@ -987,7 +1204,9 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     if contract.kind == "elastic":
         return evaluate_elastic_contract(contract, mesh=mesh)
     if mesh is None:
-        mesh = build_mesh(MeshSpec(), devices=jax.devices())
+        spec = (MeshSpec.parse(contract.mesh_spec) if contract.mesh_spec
+                else MeshSpec())
+        mesh = build_mesh(spec, devices=jax.devices())
     n_shards = batch_shard_count(mesh)
     if n_shards < contract.min_shards:
         raise ValueError(
@@ -1012,6 +1231,7 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
                         if is_fsdp else ())
     group_sizes = (trainer._fsdp_plan.padded_group_sizes
                    if is_fsdp and trainer._fsdp_plan is not None else ())
+    tp_psums, tp_gathers = trainer.tp_expected_model_collectives()
     return StepArtifacts(
         name=contract.name,
         optimized_text=optimized,
@@ -1024,6 +1244,9 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         replicated_param_buffers=replicated_params,
         layer_group_padded_sizes=group_sizes,
         backend=jax.default_backend(),
+        model_shards=trainer._tp_n,
+        tp_expected_psums=tp_psums,
+        tp_expected_model_gathers=tp_gathers,
     )
 
 

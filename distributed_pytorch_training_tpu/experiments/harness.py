@@ -19,6 +19,7 @@ differenced time is large enough to trust.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from pathlib import Path
 from typing import Callable, Optional, Sequence, Tuple
@@ -71,12 +72,17 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
                      model_name: str, seq_len: int,
                      model_kwargs: Optional[dict] = None,
                      zero1: bool = False,
-                     grad_sync: Optional[dict] = None):
+                     grad_sync: Optional[dict] = None,
+                     mesh_spec: Optional[str] = None):
     """(trainer, state, mesh) for a language-model config (gpt2_*/bert_base,
     BASELINE.json:11-12) on a pure-DP mesh, AdamW, real vocab sizes.
     `model_kwargs` overrides architecture fields (CI smoke runs shrink the
     model; benchmarks use the real sizes). ``grad_sync`` — see
-    `build_image_trainer`."""
+    `build_image_trainer`. ``mesh_spec`` ("data=-1,model=2") builds the
+    2-D explicit TP x FSDP mesh (the gpt2_355m_fsdp_tp bench arm); the
+    vocab pads to lcm(128, model) exactly as train.py pads it."""
+    import math
+
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
     from ..training import TrainConfig, Trainer
@@ -85,9 +91,15 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
         LanguageModelingTask, MaskedLMTask, MoeLanguageModelingTask,
     )
 
-    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    spec = (MeshSpec.parse(mesh_spec) if mesh_spec
+            else MeshSpec(data=len(devices)))
+    mesh = build_mesh(spec, devices=list(devices))
+    model_n = dict(mesh.shape).get("model", 1)
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     kwargs = dict(model_kwargs or {})
+    if model_n > 1:
+        kwargs.setdefault("pad_vocab_to_multiple_of",
+                          math.lcm(128, model_n))
     from ..ops.flash_attention import (
         flash_backend_supported, flash_supports_length,
     )
@@ -126,9 +138,32 @@ def build_lm_trainer(devices: Sequence[jax.Device], bf16: bool,
     # (non-shard_map) path, where a psum over the batch axes would hit
     # unbound axis names — shard_axes must follow the SAME passthrough
     # condition.
-    sharded = (zero1 or bool((grad_sync or {}).get("fsdp_explicit"))) \
-        and batch_shard_count(mesh) > 1
-    tx = adamw(1e-4, shard_axes=BATCH_AXES if sharded else None)
+    fsdp = bool((grad_sync or {}).get("fsdp_explicit"))
+    explicit_tp = fsdp and model_n > 1
+    # zero1 on a model-axis mesh runs the per-leaf GSPMD update OUTSIDE
+    # shard_map, where a batch-axes psum in the clip would hit unbound
+    # axis names — the same exclusion train.py applies
+    sharded = ((zero1 and model_n <= 1) or fsdp) \
+        and (batch_shard_count(mesh) > 1 or explicit_tp)
+    from ..parallel.mesh import MODEL
+
+    shard_axes = None
+    clip_weights = None
+    if sharded:
+        shard_axes = (((MODEL,) + BATCH_AXES) if explicit_tp
+                      else BATCH_AXES)
+    if explicit_tp:
+        # the clip's norm psum rides (model,) + batch axes; the TP layout
+        # stores model-replicated leaves once per model shard, so their
+        # squared contributions down-weight 1/M — the ONE derivation
+        # train.py also uses (parallel/sharding.py)
+        from ..parallel.sharding import tp_clip_weights_for_model
+
+        clip_weights = tp_clip_weights_for_model(
+            model, type(model).partition_rules(), model_n,
+            np.zeros((model_n, seq_len), np.int32))
+    tx = adamw(1e-4, shard_axes=shard_axes,
+               clip_leaf_weights=clip_weights)
     state = trainer.init_state(model, np.zeros((1, seq_len), np.int32),
                                tx, jax.random.PRNGKey(0))
     return trainer, state, mesh
@@ -139,13 +174,21 @@ def build_trainer(devices: Sequence[jax.Device], bf16: bool, model_name: str,
                   num_classes: int = 10,
                   lm_overrides: Optional[dict] = None,
                   zero1: bool = False,
-                  grad_sync: Optional[dict] = None):
+                  grad_sync: Optional[dict] = None,
+                  mesh_spec: Optional[str] = None):
     """Model-family dispatch used by bench.py AND the experiment drivers —
-    the same `--model` string must measure the same config everywhere."""
+    the same `--model` string must measure the same config everywhere.
+    ``mesh_spec`` ("data=-1,model=2") builds a 2-D mesh for the explicit
+    TP x FSDP arms — LM models only (image models ship replicated-only
+    partition rules)."""
     if is_lm_model(model_name):
         return build_lm_trainer(devices, bf16, model_name, seq_len,
                                 lm_overrides, zero1=zero1,
-                                grad_sync=grad_sync)
+                                grad_sync=grad_sync, mesh_spec=mesh_spec)
+    if mesh_spec:
+        raise ValueError(
+            f"mesh_spec={mesh_spec!r} is an LM-arm knob (explicit TP); "
+            f"{model_name} has no TP form")
     return build_image_trainer(devices, bf16, model_name, image_hw,
                                num_classes, zero1=zero1,
                                grad_sync=grad_sync)
@@ -339,6 +382,11 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
                 trainer._fsdp_plan.padded_group_sizes
                 if is_fsdp and trainer._fsdp_plan is not None else ()),
         )
+        tp_psums, tp_gathers = trainer.tp_expected_model_collectives()
+        artifacts = dataclasses.replace(
+            artifacts, model_shards=trainer._tp_n,
+            tp_expected_psums=tp_psums,
+            tp_expected_model_gathers=tp_gathers)
         findings = check_artifacts(artifacts)
         return {"pass": not findings,
                 "violations": [f.as_dict() for f in findings]}
@@ -406,7 +454,8 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
                          ckpt_dir: Optional[str] = None,
                          train_config=None, seed: int = 0,
                          optimizer: str = "auto", momentum: float = 0.9,
-                         weight_decay: float = 5e-4):
+                         weight_decay: float = 5e-4,
+                         mesh_spec: Optional[str] = None):
     """(engine, mesh) for a serving config on a pure-DP mesh — the serving
     sibling of `build_trainer`, so bench rows and the CLI measure the same
     engine. Without ``ckpt_dir`` the weights are random-init (a smoke of
@@ -430,7 +479,13 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
     from ..serving.engine import InferenceEngine, ServeConfig
     from ..training.optim import make_optimizer, make_schedule
 
-    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    # --mesh (ISSUE 13 satellite): default stays the 1-D pure-DP mesh —
+    # every existing invocation unchanged; "data=N,model=M" serves big
+    # models TP-sharded over the model axis via the GSPMD rules
+    # (validate_mesh rejects axes the served model cannot use).
+    spec = (MeshSpec.parse(mesh_spec) if mesh_spec
+            else MeshSpec(data=len(devices)))
+    mesh = build_mesh(spec, devices=list(devices))
     cfg = ServeConfig(buckets=tuple(buckets), rows=rows,
                       max_new_tokens=max_new_tokens, serve_dtype=serve_dtype)
     dtype = jnp.bfloat16 if serve_dtype == "bf16" else jnp.float32
@@ -450,14 +505,21 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
         kwargs.setdefault("max_position", max(512, need))
         model = get_model(model_name, dtype=dtype, **kwargs)
         sample = np.zeros((1, min(cfg.buckets)), np.int32)
+    rules = (type(model).partition_rules()
+             if hasattr(type(model), "partition_rules") else None)
+    from ..parallel.mesh import validate_mesh
+
+    validate_mesh(mesh, rules=rules)
+    serve_rules = rules if dict(mesh.shape).get("model", 1) > 1 else None
     if ckpt_dir:
         engine = InferenceEngine.from_checkpoint(
             ckpt_dir, model, mesh, cfg, tx, sample,
-            train_config=train_config)
+            train_config=train_config, rules=serve_rules)
     else:
         variables = model.init(jax.random.PRNGKey(seed), sample, train=False)
         engine = InferenceEngine(model, mesh, cfg, variables["params"],
-                                 batch_stats=variables.get("batch_stats"))
+                                 batch_stats=variables.get("batch_stats"),
+                                 rules=serve_rules)
     return engine, mesh
 
 
@@ -470,7 +532,8 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
                     ckpt_dir: Optional[str] = None, seed: int = 0,
                     optimizer: str = "auto", momentum: float = 0.9,
                     weight_decay: float = 5e-4,
-                    train_config=None) -> dict:
+                    train_config=None,
+                    mesh_spec: Optional[str] = None) -> dict:
     """Serving latency/throughput at FIXED offered load — the serving row
     of the bench table (`serving bench` prints it).
 
@@ -495,7 +558,8 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
         max_new_tokens=max_new_tokens, serve_dtype=serve_dtype,
         model_overrides=model_overrides, ckpt_dir=ckpt_dir, seed=seed,
         optimizer=optimizer, momentum=momentum,
-        weight_decay=weight_decay, train_config=train_config)
+        weight_decay=weight_decay, train_config=train_config,
+        mesh_spec=mesh_spec)
     if not engine.is_token:
         # the load generator submits token prompts; an image engine would
         # crash mid-warmup with a confusing traceback instead of this
@@ -602,7 +666,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
                    zero1: bool = False,
                    grad_sync: Optional[dict] = None,
                    comm_trace: bool = False,
-                   ckpt_ab: bool = False) -> dict:
+                   ckpt_ab: bool = False,
+                   mesh_spec: Optional[str] = None) -> dict:
     """Full self-verifying measurement of one training config.
 
     Returns a dict with samples/s, FLOPs from XLA cost analysis AND the
@@ -638,7 +703,7 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
     with ctx:
         trainer, state, mesh = build_trainer(
             devices, bf16, model_name, seq_len, image_hw, num_classes,
-            zero1=zero1, grad_sync=grad_sync)
+            zero1=zero1, grad_sync=grad_sync, mesh_spec=mesh_spec)
         batch, global_batch = make_synth_batch(
             mesh, model_name, per_device_batch, seq_len, image_hw,
             num_classes)
@@ -678,11 +743,18 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         # model shapes.
         wire_bytes = None
         gather_bytes = None
+        tp_bytes = None
         if not zero1:
+            # explicit TP: the trainer assembles the (params, cfg) pair —
+            # data-axis terms over the TP-LOCAL template, model-axis psum
+            # bytes in their own counter row (axis="model")
+            acct_params, acct_cfg = trainer.wire_accounting_inputs(
+                state, grad_sync or {}, global_batch, seq_len)
             acct = emit_wire_accounting(
-                state.params, grad_sync, batch_shard_count(trainer.mesh),
+                acct_params, acct_cfg, batch_shard_count(trainer.mesh),
                 model=model_name)
             wire_bytes = acct["wire_bytes_per_replica"]
+            tp_bytes = acct.get("tp_psum_bytes_per_replica")
             if trainer._fsdp:
                 gather_bytes = acct.get("fsdp_gather_bytes")
 
@@ -691,7 +763,8 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
             def _sacrificial():
                 trainer_t, state_t, mesh_t = build_trainer(
                     devices, bf16, model_name, seq_len, image_hw,
-                    num_classes, zero1=zero1, grad_sync=grad_sync)
+                    num_classes, zero1=zero1, grad_sync=grad_sync,
+                    mesh_spec=mesh_spec)
                 batch_t, _ = make_synth_batch(
                     mesh_t, model_name, per_device_batch, seq_len, image_hw,
                     num_classes)
@@ -765,6 +838,9 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
            if wire_bytes is not None else {}),
         **({"fsdp_gather_bytes": gather_bytes}
            if gather_bytes is not None else {}),
+        **({"tp_psum_bytes_per_replica": tp_bytes}
+           if tp_bytes is not None else {}),
+        **({"mesh_spec": mesh_spec} if mesh_spec else {}),
         # per-arm parallelism-contract verdict (analysis/hlo_rules.py):
         # bench history records whether the measured executable kept its
         # collective/wire/donation promises, not just how fast it ran

@@ -434,6 +434,101 @@ def run_fsdp(args) -> List[dict]:
     return rows
 
 
+def run_tp(args) -> List[dict]:
+    """Explicit TP x FSDP on the 2-D ("data","model") mesh vs 1-D layouts
+    of the same LM on the same devices (ISSUE 13): replicated, fsdp
+    (1-D), and fsdp x TP at model=2 (plus model=4 when the device count
+    allows a data axis >= 2 beside it).
+
+    Each row carries (a) throughput, (b) the axis-classified collective
+    census of the compiled step — model-axis psums must equal the
+    trainer's tp-psum-signature budget, param gathers/scatters must ride
+    the data axes only (the analysis/ rules, read here as recorded
+    numbers), (c) at-rest per-device parameter bytes (the 1/(N*M)
+    division claim as a number), and (d) the wire split:
+    `wire_bytes_per_replica` (data-axis, computed over the TP-LOCAL
+    slices — the 1/M reduction) next to `tp_psum_bytes_per_replica`
+    (model-axis activation traffic)."""
+    from ..parallel.grad_sync import wire_bytes_for_config
+    from ..parallel.mesh import batch_shard_count
+    from .harness import build_lm_trainer, synth_token_batch
+    from ..analysis.hlo_rules import collective_census, replica_group_axis
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return [{"mode": "skipped",
+                 "global_samples_per_s": "needs >= 2 devices"}]
+    if not is_lm_model(args.model):
+        return [{"mode": "skipped",
+                 "global_samples_per_s": "tp is an LM experiment "
+                                         "(--model gpt2_*)"}]
+    lm_kw = None
+    if args.lm_tiny:
+        lm_kw = dict(_LM_TINY)
+        if args.model.startswith("gpt2"):
+            lm_kw.pop("mlp_dim")
+    meshes = [("replicated", None, None),
+              ("fsdp", dict(fsdp_explicit=True), None),
+              ("fsdp_tp_m2", dict(fsdp_explicit=True), f"data={n // 2},model=2")]
+    if n >= 8:
+        meshes.append(("fsdp_tp_m4", dict(fsdp_explicit=True),
+                       f"data={n // 4},model=4"))
+    rows = []
+    for mode, gs, mesh_spec in meshes:
+        try:
+            trainer, state, mesh = build_lm_trainer(
+                devices, args.bf16, args.model, args.seq_len,
+                model_kwargs=lm_kw, grad_sync=gs, mesh_spec=mesh_spec)
+        except ValueError as e:
+            # infeasible arm for this model/device combo (heads not
+            # divisible by the TP degree, not enough devices): recorded,
+            # never silently dropped
+            rows.append({"mode": mode,
+                         "global_samples_per_s": f"skipped ({e})"})
+            continue
+        batch, gb = synth_token_batch(mesh, args.batch_size, args.seq_len)
+        nb = batch_shard_count(mesh)
+        model_n = dict(mesh.shape).get("model", 1)
+        compiled = trainer._train_step.lower(
+            state, batch, jax.random.PRNGKey(0)).compile()
+        by_axis: dict = {}
+        for r in collective_census(compiled.as_text()):
+            ax = (replica_group_axis(r["replica_groups"], nb, model_n)
+                  if model_n > 1 else "data")
+            key = (r["op"], ax)
+            by_axis[key] = by_axis.get(key, 0) + r["count"]
+        param_bytes = sum(
+            int(leaf.size) * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(state.params))
+        at_rest = sum(
+            int(sh.data.size) * sh.data.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(state.params)
+            for sh in leaf.addressable_shards[:1]) if trainer._fsdp \
+            else param_bytes
+        acct_params = (trainer._fsdp_local_template
+                       if trainer._tp_n > 1 else state.params)
+        cfg = dict(gs or {})
+        tp_bytes = trainer.tp_wire_bytes(gb // nb, args.seq_len)
+        wire_bytes = wire_bytes_for_config(acct_params, cfg, nb)
+        _, sps = timed_steps(compiled, state, batch, gb, args.steps,
+                             repeats=args.repeats,
+                             min_window_s=args.min_window_s)
+        rows.append({
+            "mode": mode,
+            "global_samples_per_s": round(sps, 1),
+            "model_axis_psums": by_axis.get(("all-reduce", "model"), 0),
+            "model_axis_gathers": by_axis.get(("all-gather", "model"), 0),
+            "data_axis_gathers": by_axis.get(("all-gather", "data"), 0),
+            "data_axis_scatters": (by_axis.get(("reduce-scatter", "data"), 0)
+                                   + by_axis.get(("all-to-all", "data"), 0)),
+            "param_bytes_at_rest_per_device": at_rest,
+            "wire_bytes_per_replica": wire_bytes,
+            "tp_psum_bytes_per_replica": tp_bytes,
+        })
+    return rows
+
+
 def run_pipeline(args) -> List[dict]:
     """GPipe bubble measurement: pipelined GPT-2 throughput vs microbatch
     count, against the pure-DP layout of the same model on the same devices.
@@ -512,7 +607,8 @@ def main(argv=None):
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
                    choices=["scaling", "batch", "amp", "gradsync",
-                            "grad_sync", "zero1", "fsdp", "pipeline"])
+                            "grad_sync", "zero1", "fsdp", "tp",
+                            "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
                    help="per-device batch (ref semantics, train_ddp.py:27)")
@@ -546,7 +642,7 @@ def main(argv=None):
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
           "gradsync": run_gradsync, "grad_sync": run_grad_sync,
-          "zero1": run_zero1, "fsdp": run_fsdp,
+          "zero1": run_zero1, "fsdp": run_fsdp, "tp": run_tp,
           "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "

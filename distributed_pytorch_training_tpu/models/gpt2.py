@@ -16,8 +16,10 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
+from ..parallel.collectives import copy_to_tp, reduce_from_tp, tp_all_gather
 from ..parallel.sharding import PartitionRules
 from .layers import (
     TransformerBlock,
@@ -48,6 +50,23 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
     # replication. Padded logit columns are masked to the fp32 min, so the
     # loss is identical to the unpadded head. 0 = exact HF shapes.
     pad_vocab_to_multiple_of: int = 0
+    # Explicit tensor parallelism (ISSUE 13): tp_size > 1 runs the
+    # megatron column/row-split forward with `tp_axis` bound by the
+    # enclosing shard_map (training/loop.py's explicit TP x FSDP step).
+    # When the padded vocab divides by tp_size, the (vocab, d) embedding —
+    # the largest tensor — is vocab-split too: lookups psum the per-shard
+    # partial rows, the tied head computes local logit columns and
+    # all-gathers them over the model axis (one model-axis gather per
+    # step; Megatron's parallel-vocab cross-entropy, which would avoid it,
+    # is a follow-up). Indivisible vocab degrades the embedding to
+    # model-replicated with a warning — the blocks still split.
+    tp_size: int = 1
+    tp_axis: Optional[str] = None
+
+    @property
+    def tp_vocab(self) -> bool:
+        """Whether the explicit-TP forward vocab-splits the embedding."""
+        return self.tp_size > 1 and self.padded_vocab % self.tp_size == 0
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, train: bool = False,
@@ -76,11 +95,32 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
         """
         b, s = input_ids.shape
         decoding = cache is not None and cache_positions is not None
-        wte = nn.Embed(self.padded_vocab, self.hidden_dim, dtype=self.dtype,
+        tp = self.tp_size
+        if tp > 1 and cache is not None:
+            raise ValueError(
+                "explicit TP has no KV-cache path — serve TP checkpoints "
+                "via the GSPMD rules (models/layers.py MultiHeadAttention "
+                "documents the restriction)")
+        vocab_rows = (self.padded_vocab // tp if self.tp_vocab
+                      else self.padded_vocab)
+        wte = nn.Embed(vocab_rows, self.hidden_dim, dtype=self.dtype,
                        param_dtype=self.param_dtype,
                        embedding_init=nn.initializers.normal(stddev=0.02),
                        name="wte")
-        x = wte(input_ids)
+        if self.tp_vocab:
+            # vocab-parallel lookup: this shard owns rows
+            # [shard * rows, (shard+1) * rows); out-of-range ids contribute
+            # exact zeros and the per-shard partials psum to the full
+            # embedding row (`reduce_from_tp`: backward is identity, so
+            # each shard's table gets exactly its own rows' cotangents)
+            shard = jax.lax.axis_index(self.tp_axis)
+            local_ids = input_ids - shard * vocab_rows
+            valid = (local_ids >= 0) & (local_ids < vocab_rows)
+            rows = wte(jnp.clip(local_ids, 0, vocab_rows - 1))
+            x = reduce_from_tp(
+                jnp.where(valid[..., None], rows, 0.0), self.tp_axis)
+        else:
+            x = wte(input_ids)
         pos_ids = (cache_positions[:, None] if decoding
                    else jnp.arange(s)[None, :])
         x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
@@ -119,6 +159,7 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
                 dropout_rate=self.dropout_rate,
                 layernorm_epsilon=self.layernorm_epsilon,
                 attention_fn=self.attention_fn,
+                tp_size=tp, tp_axis=self.tp_axis,
                 name=f"block{i}",
             )
             if cache is None:
@@ -130,7 +171,16 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
 
         x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
-        logits = wte.attend(x)  # tied LM head (HF GPT-2 ties wte <-> lm_head)
+        if self.tp_vocab:
+            # vocab-parallel tied head: local logit columns, one model-axis
+            # all-gather (`tp_all_gather`: backward takes this shard's
+            # slice of the cotangent — no collective); `copy_to_tp` at the
+            # matmul input so ln_f and the residual stream see the full
+            # summed cotangent
+            logits = tp_all_gather(wte.attend(copy_to_tp(x, self.tp_axis)),
+                                   self.tp_axis, 2)
+        else:
+            logits = wte.attend(x)  # tied LM head (HF ties wte <-> lm_head)
         logits = mask_vocab_padding(logits.astype(jnp.float32),
                                     self.vocab_size)
         return logits if cache is None else (logits, tuple(new_cache))

@@ -26,11 +26,49 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel.collectives import copy_to_tp, reduce_from_tp
 from ..parallel.mesh import FSDP, MODEL
 from ..parallel.sharding import PartitionRules
 from jax.sharding import PartitionSpec as P
 
 Dtype = Any
+
+
+class RowParallelDense(nn.Module):
+    """Megatron row-parallel linear for the EXPLICIT TP forward (inside a
+    shard_map with the ``model`` axis bound): the kernel's contracting
+    (input) dims are a per-shard slice, the partial product is psum'd over
+    the TP axis (`reduce_from_tp` — THE one forward psum per residual
+    join), and the bias — a full, model-replicated parameter — is added
+    AFTER the psum so it lands exactly once. Param paths match the GSPMD
+    module's (``<name>/kernel``, ``<name>/bias``): the same checkpoint tree,
+    just with the kernel holding this shard's rows."""
+
+    features: int
+    tp_axis: str
+    n_contract_dims: int = 1  # trailing input dims contracted (DenseGeneral axis)
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        nd = self.n_contract_dims
+        contract_shape = x.shape[-nd:]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(
+                in_axis=tuple(range(nd)), out_axis=-1),
+            contract_shape + (self.features,), self.param_dtype)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), kernel.astype(self.dtype),
+            ((tuple(range(x.ndim - nd, x.ndim)), tuple(range(nd))),
+             ((), ())))
+        y = reduce_from_tp(y, self.tp_axis)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), self.param_dtype)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 def dot_product_attention(
@@ -88,6 +126,16 @@ class MultiHeadAttention(nn.Module):
     `attention_fn(q, k, v, mask, dtype)` defaults to the XLA einsum path;
     swap in `ops.flash_attention` / `ops.ring_attention` for long context.
 
+    Explicit TP (``tp_size`` > 1, inside a shard_map binding ``tp_axis``):
+    megatron column/row split — the qkv projection holds this shard's
+    ``num_heads / tp_size`` heads (column-parallel, `copy_to_tp` at its
+    input so the backward sums the per-shard cotangents), attention runs on
+    the local heads, and the out projection is `RowParallelDense` (one
+    forward psum per residual join, bias added once after it). Param tree
+    paths are unchanged; kernel/bias SHAPES hold the local slice, exactly
+    the `tp_fsdp_rules()` model-axis dims — the passive GSPMD constraints
+    read as the explicit layout contract.
+
     KV cache (serving/): ``cache=(k, v)`` of shape (B, T, H, D) engages the
     incremental-decoding path and the call returns ``(out, new_cache)``.
     Two cache writes exist:
@@ -114,6 +162,8 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     use_bias: bool = True
     attention_fn: Callable = dot_product_attention
+    tp_size: int = 1
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True,
@@ -122,6 +172,8 @@ class MultiHeadAttention(nn.Module):
         dense = functools.partial(nn.DenseGeneral, dtype=self.dtype,
                                   param_dtype=self.param_dtype,
                                   use_bias=self.use_bias)
+        if self.tp_size > 1:
+            return self._tp_call(x, mask, deterministic, cache, dense)
         qkv = dense(features=(3, self.num_heads, self.head_dim), name="qkv")(x)
         q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
         new_cache = None
@@ -160,17 +212,70 @@ class MultiHeadAttention(nn.Module):
                               use_bias=self.use_bias, name="out")(y)
         return out if cache is None else (out, new_cache)
 
+    def _tp_call(self, x, mask, deterministic, cache, dense):
+        """The explicit-TP attention body (tp_size > 1): local head slice,
+        one forward psum at the out projection."""
+        if cache is not None:
+            raise ValueError(
+                "explicit TP attention has no KV-cache path — serve TP "
+                "checkpoints via the GSPMD rules (--mesh model=N without "
+                "--fsdp-explicit on the serving side)")
+        if self.dropout_rate and not deterministic:
+            raise ValueError(
+                "explicit TP runs the dropout RNG stream replicated over "
+                "the model axis; per-shard head slices would draw "
+                "correlated masks — train explicit TP with dropout 0")
+        if self.num_heads % self.tp_size:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"tp_size={self.tp_size}")
+        heads_local = self.num_heads // self.tp_size
+        x = copy_to_tp(x, self.tp_axis)
+        qkv = dense(features=(3, heads_local, self.head_dim),
+                    name="qkv")(x)
+        q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        y = self.attention_fn(q, k, v, mask=mask, dtype=self.dtype)
+        return RowParallelDense(
+            features=x.shape[-1], tp_axis=self.tp_axis, n_contract_dims=2,
+            use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="out")(y)
+
 
 class MlpBlock(nn.Module):
+    """Transformer MLP. Explicit TP (``tp_size`` > 1): fc1 is
+    column-parallel (this shard's ``hidden_dim / tp_size`` neurons, with
+    its bias slice), fc2 is `RowParallelDense` — one forward psum per
+    residual join, full bias added once after it."""
+
     hidden_dim: int
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     dropout_rate: float = 0.0
     activation: Callable = nn.gelu
+    tp_size: int = 1
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
         d = x.shape[-1]
+        if self.tp_size > 1:
+            if self.dropout_rate and not deterministic:
+                raise ValueError(
+                    "explicit TP runs the dropout RNG stream replicated "
+                    "over the model axis; per-shard neuron slices would "
+                    "draw correlated masks — train explicit TP with "
+                    "dropout 0")
+            if self.hidden_dim % self.tp_size:
+                raise ValueError(
+                    f"hidden_dim={self.hidden_dim} not divisible by "
+                    f"tp_size={self.tp_size}")
+            x = copy_to_tp(x, self.tp_axis)
+            h = nn.Dense(self.hidden_dim // self.tp_size, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="fc1")(x)
+            h = self.activation(h)
+            return RowParallelDense(
+                features=d, tp_axis=self.tp_axis, dtype=self.dtype,
+                param_dtype=self.param_dtype, name="fc2")(h)
         h = nn.Dense(self.hidden_dim, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="fc1")(x)
         h = self.activation(h)
@@ -192,6 +297,8 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     layernorm_epsilon: float = 1e-5
     attention_fn: Callable = dot_product_attention
+    tp_size: int = 1
+    tp_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, deterministic: bool = True,
@@ -203,6 +310,7 @@ class TransformerBlock(nn.Module):
             num_heads=self.num_heads, head_dim=self.head_dim, dtype=self.dtype,
             param_dtype=self.param_dtype, dropout_rate=self.dropout_rate,
             attention_fn=self.attention_fn, name="attn",
+            tp_size=self.tp_size, tp_axis=self.tp_axis,
         )(y, mask=mask, deterministic=deterministic, cache=cache,
           cache_positions=cache_positions)
         new_cache = None
@@ -213,6 +321,7 @@ class TransformerBlock(nn.Module):
         y = MlpBlock(hidden_dim=self.mlp_dim, dtype=self.dtype,
                      param_dtype=self.param_dtype,
                      dropout_rate=self.dropout_rate, name="mlp",
+                     tp_size=self.tp_size, tp_axis=self.tp_axis,
                      )(y, deterministic=deterministic)
         return x + y if cache is None else (x + y, new_cache)
 
@@ -276,6 +385,12 @@ def tp_fsdp_rules() -> PartitionRules:
     pure DP (both axes 1) reproduces the DDP replicated layout, ``--mesh
     model=N`` is pure TP, ``--mesh fsdp=N`` is pure FSDP, and ``--mesh
     fsdp=M,model=N`` is 2-D parameter sharding.
+
+    The EXPLICIT TP x FSDP step (ISSUE 13) reads this same table as its
+    layout contract: `parallel.sharding.tp_split_dims` takes each leaf's
+    model-axis dim from these specs, and the tp_size>1 module forms above
+    compute with exactly those slices — the passive GSPMD constraints and
+    the explicit layout cannot disagree.
 
     Because `shard_pytree` applies the same table to the optimizer state,
     the AdamW/SGD moments are sharded identically — the ZeRO-2/3 memory win.

@@ -330,6 +330,43 @@ def ring_attention(
                          out_specs=spec)(q, k, v)
 
 
+def ring_attention_sharded(
+    q: jnp.ndarray,  # (B, S_loc, H, D) — THIS shard's sequence block
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = SEQ,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    q_chunk: int = 512,
+    use_pallas: Optional[bool] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+) -> jnp.ndarray:
+    """`ring_attention`'s body for callers ALREADY inside a shard_map —
+    the explicit TP x FSDP step runs its whole program in one shard_map
+    over the 2-D ("data","model") mesh (training/loop.py), where a nested
+    shard_map cannot open; this entry takes the bound ``axis_name``
+    directly (any axis of that mesh — ``seq`` for sequence-length scaling
+    beside the TP axes) and operands that are the per-shard blocks.
+    Same kernel dispatch as `ring_attention` (fused ring+flash on TPU
+    when the shard length has a usable block, q-chunked einsum
+    otherwise), resolved from the LOCAL shard length — the caller's
+    shapes are already per-shard."""
+    from .flash_attention import flash_backend_supported, flash_supports_length
+
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(q.shape[-1])
+    s_loc = q.shape[1]
+    if use_pallas is None:
+        use_pallas = (flash_backend_supported()
+                      and flash_supports_length(s_loc, block_q)
+                      and flash_supports_length(s_loc, block_k))
+    if use_pallas:
+        return _ring_flash(q, k, v, axis_name, causal, scale,
+                           block_q, block_k)
+    return _ring_body(q, k, v, axis_name=axis_name, causal=causal,
+                      sm_scale=scale, q_chunk=q_chunk)
+
+
 def make_ring_attention_fn(mesh: Mesh, causal: bool, axis_name: str = SEQ,
                            q_chunk: int = 512,
                            use_pallas: Optional[bool] = None):

@@ -17,20 +17,24 @@ from .mesh import (  # noqa: F401
     batch_shard_count,
     build_mesh,
     local_batch_size,
+    validate_mesh,
 )
 from .collectives import (  # noqa: F401
     all_gather,
     all_to_all,
     barrier,
     broadcast_from_main,
+    copy_to_tp,
     host_all_gather,
     pmax,
     pmean,
     ppermute_ring,
     psum,
     psum_scatter,
+    reduce_from_tp,
     reduce_scalar,
     shard_map,
+    tp_all_gather,
 )
 from .grad_sync import (  # noqa: F401
     WIRE_DTYPES,

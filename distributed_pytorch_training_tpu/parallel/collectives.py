@@ -29,6 +29,7 @@ Two distinct layers, never to be confused:
 
 from __future__ import annotations
 
+import functools
 import inspect
 from typing import Any, Callable, Optional, Sequence, Union
 
@@ -153,6 +154,91 @@ def ppermute_ring(x: Any, axis_name: str, *, shift: int = 1) -> Any:
 def all_to_all(x: Any, axis_name: str, split_axis: int, concat_axis: int) -> Any:
     """All-to-all over a mesh axis — the Ulysses (head-sharding) primitive."""
     return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Explicit tensor-parallel region operators (Megatron's f / g).
+#
+# Inside a shard_map'd train step the TP layers consume a replicated
+# activation with per-shard weight slices; autodiff must then produce
+# (a) a full (cross-shard-summed) cotangent flowing UPSTREAM of each
+# parallel region — each shard's slice contributes an independent partial —
+# and (b) an identity backward through the output psum (the cotangent of a
+# replicated value consumed replicatedly is itself). jax's built-in
+# transpose rules for psum/all_gather encode a different cotangent
+# convention under check-free shard_map (per-device cotangents SUM across
+# replicas), which would scale gradients by the TP degree here. These
+# custom_vjp wrappers pin the exact collective structure of both passes BY
+# CONSTRUCTION, independent of jax-version transpose conventions — one
+# model-axis psum per residual join in the forward, its mirror at the
+# region input in the backward (models/layers.py uses them; the
+# `tp-psum-signature` analysis rule counts them in HLO).
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp(x: jnp.ndarray, axis_name: AxisName) -> jnp.ndarray:
+    """Megatron's ``f``: identity forward into a tensor-parallel region,
+    SUM over the TP axis in the backward. Placed at each parallel region's
+    input (the qkv / fc1 projection input, the tied-head matmul input), so
+    every upstream consumer — layernorms, embeddings, the residual stream —
+    receives the full cotangent instead of one shard's partial."""
+    return x
+
+
+def _copy_to_tp_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_to_tp_bwd(axis_name, _res, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+copy_to_tp.defvjp(_copy_to_tp_fwd, _copy_to_tp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp(x: jnp.ndarray, axis_name: AxisName) -> jnp.ndarray:
+    """Megatron's ``g``: SUM the row-parallel partial outputs over the TP
+    axis in the forward (THE one psum per residual join), identity in the
+    backward (the summed output is replicated; each shard's partial gets
+    the replicated cotangent unchanged)."""
+    return lax.psum(x, axis_name)
+
+
+def _reduce_from_tp_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_from_tp_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+reduce_from_tp.defvjp(_reduce_from_tp_fwd, _reduce_from_tp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def tp_all_gather(x: jnp.ndarray, axis_name: AxisName,
+                  dim: int) -> jnp.ndarray:
+    """Concatenate per-shard slices along ``dim`` over the TP axis
+    (the vocab-parallel logits gather), with the exact backward: each
+    shard takes ITS slice of the (replicated) cotangent — a dynamic
+    slice, no collective. jax's built-in all_gather transpose is a
+    psum_scatter, which under the check-free shard_map convention would
+    scale the cotangent by the TP degree (see `copy_to_tp`)."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _tp_all_gather_fwd(x, axis_name, dim):
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True), x.shape[dim]
+
+
+def _tp_all_gather_bwd(axis_name, dim, size, ct):
+    idx = lax.axis_index(axis_name)
+    return (lax.dynamic_slice_in_dim(ct, idx * size, size, axis=dim),)
+
+
+tp_all_gather.defvjp(_tp_all_gather_fwd, _tp_all_gather_bwd)
 
 
 # ---------------------------------------------------------------------------

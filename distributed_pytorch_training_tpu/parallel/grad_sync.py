@@ -221,13 +221,38 @@ def fsdp_gather_bytes(params: Any, wire_dtype: str, n_shards: int) -> int:
     return total if wire_dtype == "int8_multihop" else 4 * total
 
 
+def tp_psum_bytes_per_step(hidden: int, depth: int, local_batch: int,
+                           seq: int, model_n: int, tp_vocab: bool = False,
+                           padded_vocab: int = 0) -> int:
+    """Per-replica MODEL-axis wire bytes of ONE explicit-TP train step
+    (ISSUE 13) — the TP term `wire_bytes_for_config` grows and
+    `emit_wire_accounting` tags with its own tier row.
+
+    Conventions (payload only, matching `wire_bytes_per_replica`): each
+    megatron psum is an fp32 ring all-reduce of one (local_batch, seq,
+    hidden) activation — ~8 bytes/element; the step carries 4 per block
+    (forward g + backward f mirrors) plus 2 with the vocab-parallel
+    embedding (`Trainer.tp_expected_model_collectives` is the same
+    arithmetic read off the trainer). The vocab-parallel logits gather
+    adds ~4 bytes x (local_batch, seq, padded_vocab) (the (M-1)/M gather
+    volume rounded up, the convention the data-axis formulas use)."""
+    if model_n <= 1:
+        return 0
+    act = local_batch * seq * hidden
+    n_psums = 4 * depth + (2 if tp_vocab else 0)
+    total = 8 * act * n_psums
+    if tp_vocab:
+        total += 4 * local_batch * seq * padded_vocab
+    return total
+
+
 def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
                           n_shards: int) -> int:
     """`wire_bytes_per_replica` from a TrainConfig-style override dict
     (``bucket_cap_mb`` / ``wire_dtype`` / ``fsdp_explicit``, with the
     TrainConfig defaults) — the ONE accounting call both bench
-    (`harness.measure_config`) and scaling (`run_grad_sync` / `run_fsdp`)
-    record, so their rows cannot drift apart.
+    (`harness.measure_config`) and scaling (`run_grad_sync` / `run_fsdp` /
+    `run_tp`) record, so their rows cannot drift apart.
 
     For ``fsdp_explicit`` configs the number is scatter + gather: the
     gradient reduce-scatter at the wire dtype (4/2/1/1 bytes per padded
@@ -235,19 +260,27 @@ def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
     all-reduce) plus the `fsdp_gather_bytes` per-layer gather term. Only
     ``int8_multihop`` compresses both directions (~2 B/element total,
     independent of n — asserted by tests, like the multihop gradient
-    wire's)."""
+    wire's).
+
+    Explicit TP x FSDP: pass the TP-LOCAL parameter template as
+    ``params`` (the trainer's `_fsdp_local_template` — gathers/scatters
+    move each model shard's local slice only, the 1/M reduction) and the
+    model-axis activation term via ``cfg["tp_psum_bytes"]``
+    (`tp_psum_bytes_per_step`); the result is the TOTAL data-axis +
+    model-axis per-replica bytes."""
     cfg = dict(grad_sync_cfg or {})
     wire = cfg.get("wire_dtype", "fp32")
     if wire not in WIRE_DTYPES:
         raise ValueError(f"unknown wire dtype {wire!r} "
                          f"(choose from {WIRE_DTYPES})")
+    tp_bytes = int(cfg.get("tp_psum_bytes", 0))
     if cfg.get("fsdp_explicit"):
         if n_shards <= 1:
-            return 0
+            return tp_bytes
         total = _flat_padded_total(params, n_shards)
         scatter = {"fp32": 4, "bf16": 2, "int8": 1,
                    "int8_multihop": 1}[wire] * total
-        return scatter + fsdp_gather_bytes(params, wire, n_shards)
+        return scatter + fsdp_gather_bytes(params, wire, n_shards) + tp_bytes
     plan = build_bucket_plan(params, float(cfg.get("bucket_cap_mb", 0.0)))
     return wire_bytes_per_replica(plan, wire, n_shards)
 
@@ -265,22 +298,39 @@ def emit_wire_accounting(params: Any, grad_sync_cfg: Optional[dict],
     emit one counter set per tier through this same call, which is why
     the attribute exists now (per-tier byte/time telemetry is the
     substrate that item presumes). Extra ``attrs`` (e.g. the bench's
-    ``model=...``) ride every emitted counter."""
+    ``model=...``) ride every emitted counter.
+
+    Explicit TP x FSDP (``cfg["model_shards"]`` > 1 with
+    ``cfg["tp_psum_bytes"]``): the model-axis activation bytes land in
+    their OWN counter row (``tp_psum_bytes_per_replica``, axis="model")
+    so ``telemetry summary`` splits TP psum traffic from the data-axis
+    gradient sync, and ``wire_bytes_per_replica`` stays the data-axis
+    number (tagged axis="data"). With no model axis the emission is
+    byte-identical to before."""
     from .. import telemetry
 
     cfg = dict(grad_sync_cfg or {})
     wire = cfg.get("wire_dtype", "fp32")
+    model_shards = int(cfg.get("model_shards", 1))
+    tp_bytes = int(cfg.get("tp_psum_bytes", 0)) if model_shards > 1 else 0
+    data_cfg = {k: v for k, v in cfg.items() if k != "tp_psum_bytes"}
     out = {"tier": tier, "wire_dtype": wire, "n_shards": n_shards,
            "wire_bytes_per_replica": wire_bytes_for_config(
-               params, cfg, n_shards)}
+               params, data_cfg, n_shards)}
+    axis_attr = {"axis": "data"} if model_shards > 1 else {}
     telemetry.counter("wire_bytes_per_replica",
                       out["wire_bytes_per_replica"], tier=tier,
-                      wire_dtype=wire, n_shards=n_shards, **attrs)
+                      wire_dtype=wire, n_shards=n_shards, **axis_attr,
+                      **attrs)
     if cfg.get("fsdp_explicit"):
         out["fsdp_gather_bytes"] = fsdp_gather_bytes(params, wire, n_shards)
         telemetry.counter("fsdp_gather_bytes", out["fsdp_gather_bytes"],
                           tier=tier, wire_dtype=wire, n_shards=n_shards,
-                          **attrs)
+                          **axis_attr, **attrs)
+    if tp_bytes:
+        out["tp_psum_bytes_per_replica"] = tp_bytes
+        telemetry.counter("tp_psum_bytes_per_replica", tp_bytes, tier=tier,
+                          axis="model", model_shards=model_shards, **attrs)
     return out
 
 
@@ -737,18 +787,20 @@ def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
 # ---------------------------------------------------------------------------
 
 
-def _born_sharded_zeros(structs: Any, mesh):
+def _born_sharded_zeros(structs: Any, mesh, axes=None):
     """Zeros pytree (of jax.ShapeDtypeStruct leaves) created ALREADY
-    sharded over the batch axes (the optim.zero1_opt_state idiom): jit
-    with out_shardings makes XLA allocate each replica's rows in place —
-    no full-array transient on device 0 (for gpt2-scale params,
+    sharded over ``axes`` (default: the batch axes — the
+    optim.zero1_opt_state idiom; explicit TP passes (model,) + batch):
+    jit with out_shardings makes XLA allocate each replica's rows in
+    place — no full-array transient on device 0 (for gpt2-scale params,
     n_shards x param bytes would be a multi-GB spike at init_state)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .mesh import BATCH_AXES
 
+    axes = tuple(axes) if axes is not None else BATCH_AXES
     shardings = jax.tree_util.tree_map(
-        lambda _: NamedSharding(mesh, P(BATCH_AXES)), structs)
+        lambda _: NamedSharding(mesh, P(axes)), structs)
     make = jax.jit(
         lambda: jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), structs),
@@ -779,20 +831,29 @@ def ef_state_bucketed(params: Any, mesh, n_shards: int,
     return {"ef": _born_sharded_zeros(struct, mesh)}
 
 
-def ef_state_fsdp(params: Any, mesh, n_shards: int):
+def ef_state_fsdp(params: Any, mesh, n_shards: int, model_n: int = 1):
     """Per-replica residuals for the explicit-FSDP int8 gradient scatter:
     one (n_shards, n_shards * row_size) fp32 array PER LAYER GROUP (the
     scatter is per layer there — `build_layer_plan`), keyed by group name,
     sharded over the batch axes so each replica materializes only its row.
     The residual length is the group's full padded size: EF must remember
     what was dropped from EVERY destination chunk, not just the kept one
-    (the `compressed_psum_scatter` convention)."""
+    (the `compressed_psum_scatter` convention).
+
+    Explicit TP x FSDP (``model_n`` > 1): ``params`` is the TP-LOCAL
+    template — each (model shard, data replica) pair runs its own
+    data-axis scatter over its local row, so the row dim grows to
+    ``model_n * n_shards`` (model-major, matching the at-rest layout) and
+    the rows shard over (model,) + batch axes."""
+    from .mesh import BATCH_AXES, MODEL
+
     plan = build_layer_plan(params, n_shards)
     structs = {
         g.name: jax.ShapeDtypeStruct(
-            (n_shards, n_shards * g.row_size), jnp.float32)
+            (model_n * n_shards, n_shards * g.row_size), jnp.float32)
         for g in plan.groups}
-    return {"ef": _born_sharded_zeros(structs, mesh)}
+    axes = ((MODEL,) + BATCH_AXES) if model_n > 1 else BATCH_AXES
+    return {"ef": _born_sharded_zeros(structs, mesh, axes=axes)}
 
 
 def fold_ef_rows(rows, new_n: int):

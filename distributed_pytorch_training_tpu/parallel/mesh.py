@@ -298,6 +298,14 @@ def validate_mesh_usage(
             "memory win)", mesh.shape[FSDP])
 
 
+# `validate_mesh_usage` under the name the --mesh CLI threading uses
+# (ISSUE 13 satellite): every --mesh consumer — train.py and the serving
+# CLI — must reject axes the selected model/config cannot use LOUDLY
+# instead of silently replicating work across them. A true alias (not a
+# forwarding wrapper), so the two names can never drift apart.
+validate_mesh = validate_mesh_usage
+
+
 def batch_shard_count(mesh: Mesh) -> int:
     """Number of ways the global batch is split (product of batch axes)."""
     return int(np.prod([mesh.shape[a] for a in BATCH_AXES]))

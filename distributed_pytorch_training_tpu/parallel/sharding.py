@@ -218,6 +218,176 @@ def fsdp_flat_params(params: Any, mesh: Mesh, n_shards: int) -> Any:
     return make(params)
 
 
+# ---------------------------------------------------------------------------
+# Explicit TP x FSDP layout (ISSUE 13): the tp_fsdp_rules() table read as an
+# EXPLICIT layout contract. Each leaf gets a model-axis split dim from the
+# rules (None = model-replicated); the at-rest layout is then model-major
+# flat-padded: (M * flat_padded(local_size, N),) where "local" is the leaf's
+# contiguous TP slice (split leaves) or a full per-model-shard copy
+# (replicated leaves — same per-device bytes as plain model-axis
+# replication, but a UNIFORM one-spec layout so the moments/EF machinery of
+# explicit FSDP applies verbatim). Sharded P((model, data, fsdp)) on dim 0,
+# so inside the step's shard_map each device holds exactly its (padded/N,)
+# chunk of its model shard's slice.
+# ---------------------------------------------------------------------------
+
+
+def tp_split_dims(template: Any, rules: Optional[PartitionRules],
+                  model_n: int) -> Any:
+    """Per-leaf model-axis split dim (or None) — the tp_fsdp_rules() table
+    read as the explicit-TP layout contract.
+
+    A leaf splits on the first spec dim whose entry names the ``model``
+    axis, IF that dim divides by ``model_n``; indivisible dims degrade to
+    model-replication with the same warn-once `feasible_spec` issues (the
+    GPT-2 vocab embedding without Megatron padding is the canonical case).
+    The EXPLICIT TP forward (models/layers.py tp_size>1) derives its local
+    shapes from the same divisibility conditions, so plan and computation
+    cannot disagree."""
+    from .mesh import MODEL
+
+    def one(path, leaf):
+        spec = spec_for_path(rules, _path_str(path), np.ndim(leaf))
+        shape = np.shape(leaf)
+        for dim, entry in enumerate(tuple(spec)):
+            if entry is None:
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            if MODEL not in names:
+                continue
+            if shape[dim] % model_n:
+                key = (("tp", tuple(spec)), shape, model_n)
+                if key not in _degraded_warned:
+                    _degraded_warned.add(key)
+                    logger.warning(
+                        "explicit TP: %s dim %d (size %d) not divisible by "
+                        "model=%d — leaf stays model-replicated (Megatron "
+                        "vocab padding un-degrades embeddings)",
+                        _path_str(path), dim, shape[dim], model_n)
+                return None
+            return dim
+        return None
+
+    return jax.tree_util.tree_map_with_path(one, template)
+
+
+def tp_local_struct(template: Any, split_dims: Any, model_n: int) -> Any:
+    """ShapeDtypeStruct tree of the per-model-shard LOCAL shapes: split
+    leaves shrink their split dim by 1/M, replicated leaves keep their full
+    shape (each model shard holds a copy)."""
+
+    import jax.numpy as jnp
+
+    def one(leaf, dim):
+        shape = list(np.shape(leaf))
+        if dim is not None:
+            shape[dim] //= model_n
+        return jax.ShapeDtypeStruct(tuple(shape), jnp.result_type(leaf))
+
+    return jax.tree_util.tree_map(one, template, split_dims)
+
+
+def _tp_slice(x, dim: Optional[int], model_n: int, shard: int):
+    """Model shard ``shard``'s contiguous local slice of one leaf (the full
+    leaf when dim is None)."""
+    import jax.numpy as jnp  # noqa: F401
+
+    if dim is None:
+        return x
+    c = x.shape[dim] // model_n
+    return jax.lax.slice_in_dim(x, shard * c, (shard + 1) * c, axis=dim)
+
+
+def tp_flat_leaf(x, dim: Optional[int], model_n: int, n_shards: int):
+    """One leaf's model-major flat-padded at-rest vector: the concatenation
+    over model shards of flat_padded(ravel(local slice), N). Trace-time
+    Python loop over M (small); C-order ravel of each LOCAL slice, so the
+    in-step per-layer gather's reshape-to-local-shape is pure arithmetic."""
+    import jax.numpy as jnp
+
+    rows = [flatten_pad(_tp_slice(x, dim, model_n, s), n_shards)
+            for s in range(model_n)]
+    return jnp.concatenate(rows) if model_n > 1 else rows[0]
+
+
+def fsdp_tp_flat_params(params: Any, mesh: Mesh, n_shards: int,
+                        model_n: int, split_dims: Any,
+                        axes: Sequence[str]) -> Any:
+    """`fsdp_flat_params` for the 2-D (TP x FSDP) layout: every leaf lands
+    in the model-major flat-padded form (`tp_flat_leaf`), born sharded over
+    ``axes`` so each device writes only its chunk in place."""
+    structs = jax.eval_shape(
+        lambda p: jax.tree_util.tree_map(
+            lambda x, d: tp_flat_leaf(x, d, model_n, n_shards),
+            p, split_dims), params)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, P(tuple(axes)) if np.ndim(s) else P()),
+        structs)
+    make = jax.jit(
+        lambda p: jax.tree_util.tree_map(
+            lambda x, d: tp_flat_leaf(x, d, model_n, n_shards),
+            p, split_dims),
+        out_shardings=shardings)
+    return make(params)
+
+
+def tp_unflatten_leaf(flat, full_shape: Tuple[int, ...], dtype,
+                      dim: Optional[int], model_n: int):
+    """Model-shaped leaf from its model-major flat-padded at-rest vector
+    (outside shard_map — eval/diagnostics; GSPMD inserts the movement).
+    Split leaves re-concatenate their M local slices along the split dim;
+    replicated leaves take copy 0 (all copies are bit-identical — each
+    model group runs the same data-axis scatter on the same grads)."""
+    import jax.numpy as jnp
+
+    full_shape = tuple(full_shape)
+    local_shape = list(full_shape)
+    if dim is not None:
+        local_shape[dim] //= model_n
+    size = int(np.prod(local_shape) or 1)
+    mat = flat.reshape(model_n, -1)[:, :size]
+    if dim is None:
+        return mat[0].reshape(full_shape).astype(dtype)
+    rows = [mat[s].reshape(local_shape) for s in range(model_n)]
+    return jnp.concatenate(rows, axis=dim).astype(dtype)
+
+
+def tp_clip_weights_for_model(model, rules: Optional[PartitionRules],
+                              model_n: int, sample_input) -> dict:
+    """`tp_clip_weights` derived straight from a model + its rules — THE
+    one derivation both train.py and the bench harness use (a weighting
+    rule living in two hand-rolled copies would silently diverge between
+    the CLI and the bench arms). One abstract trace of ``model.init`` on
+    ``sample_input`` recovers the leaf paths/shapes the divisibility
+    decisions need."""
+    import functools
+
+    import jax.numpy as jnp
+
+    template = jax.eval_shape(
+        functools.partial(model.init, train=False), jax.random.PRNGKey(0),
+        jnp.asarray(sample_input))["params"]
+    split_dims = tp_split_dims(template, rules, model_n)
+    return tp_clip_weights(template, split_dims, model_n)
+
+
+def tp_clip_weights(template: Any, split_dims: Any, model_n: int) -> dict:
+    """{'/'.joined leaf path: squared-norm weight} for the TP-aware global
+    norm clip (optim.clip_by_global_norm_dp): a psum over
+    (model,) + batch axes counts model-replicated leaves M times (each
+    model shard holds a copy), so their squared contribution weighs 1/M;
+    TP-split leaves' disjoint slices weigh 1. Exact in fp32 for
+    power-of-two M (the usual TP degrees); otherwise a reassociation-level
+    perturbation PARITY.md documents."""
+    out = {}
+    flat = jax.tree_util.tree_leaves_with_path(
+        jax.tree_util.tree_map(lambda l, d: (d is not None), template,
+                               split_dims))
+    for path, is_split in flat:
+        out[_path_str(path)] = 1.0 if is_split else 1.0 / model_n
+    return out
+
+
 def reshard_flat_padded(x, new_padded_len: int, name: str = "") -> "np.ndarray":
     """Re-slice one flat-padded leaf from old-N chunking to new-M chunking.
 

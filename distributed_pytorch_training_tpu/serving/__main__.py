@@ -65,6 +65,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "from this directory (omit: random-init smoke)")
     p.add_argument("--serve-dtype", default="fp32",
                    choices=["fp32", "bf16", "int8"])
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec, e.g. 'data=4,model=2' (default: pure "
+                        "DP over all devices) — model>1 shards the served "
+                        "weights over the model axis via the model's "
+                        "GSPMD partition rules (multi-chip serving of "
+                        "models too big for one chip); validate_mesh "
+                        "rejects axes the served model cannot use")
     p.add_argument("--buckets", default="16,32",
                    help="prompt-length bucket ladder, e.g. '32,64,128'")
     p.add_argument("--rows", type=int, default=8,
@@ -168,7 +175,9 @@ def _run(args, buckets) -> int:
 
     enable_persistent_compile_cache(compile_cache_dir(
         Path(args.output_dir) / ".jax_cache",
-        topology=f"{jax.default_backend()}-{len(jax.devices())}dev",
+        topology=f"{jax.default_backend()}-{len(jax.devices())}dev"
+                 + (f"-{args.mesh.replace('=', '').replace(',', '-')}"
+                    if args.mesh else ""),
         config_tag=f"{args.model}-{args.serve_dtype}-rows{args.rows}"))
 
     if args.command == "bench":
@@ -179,7 +188,8 @@ def _run(args, buckets) -> int:
             serve_dtype=args.serve_dtype, model_overrides=overrides,
             ckpt_dir=args.ckpt_dir, seed=args.seed,
             optimizer=args.optimizer, momentum=args.momentum,
-            weight_decay=args.weight_decay, train_config=train_config)
+            weight_decay=args.weight_decay, train_config=train_config,
+            mesh_spec=args.mesh)
         if args.as_json:
             print(json.dumps(row, sort_keys=True, default=str))
         else:
@@ -203,7 +213,7 @@ def _run(args, buckets) -> int:
         model_overrides=overrides, ckpt_dir=args.ckpt_dir,
         train_config=train_config, seed=args.seed,
         optimizer=args.optimizer, momentum=args.momentum,
-        weight_decay=args.weight_decay)
+        weight_decay=args.weight_decay, mesh_spec=args.mesh)
     if engine.checkpoint_info:
         info = engine.checkpoint_info
         log_main(f"serving: checkpoint label={info['label']} "

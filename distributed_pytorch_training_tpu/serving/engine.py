@@ -162,11 +162,26 @@ class InferenceEngine:
     """
 
     def __init__(self, model, mesh, config: ServeConfig, params,
-                 batch_stats: Any = None):
+                 batch_stats: Any = None, rules=None):
+        from ..parallel.mesh import MODEL
+
         self.model = model
         self.mesh = mesh
         self.config = config
         n_shards = batch_shard_count(mesh)
+        model_n = dict(mesh.shape).get(MODEL, 1)
+        if model_n > 1 and rules is None:
+            raise ValueError(
+                f"mesh has model={model_n} but the engine was given no "
+                "partition rules — serving shards weights over the model "
+                "axis via the model's GSPMD rules (tp_fsdp_rules); pass "
+                "rules= (harness.build_serving_engine does)")
+        if model_n > 1 and config.serve_dtype == "int8":
+            raise ValueError(
+                "--serve-dtype int8 on a model-axis mesh is not supported "
+                "yet: the per-row quantized codes carry their own layout "
+                "(serve fp32/bf16 with --mesh model>1, or int8 on a 1-D "
+                "mesh)")
         if config.rows % n_shards:
             raise ValueError(
                 f"rows={config.rows} must divide over the mesh's "
@@ -189,7 +204,16 @@ class InferenceEngine:
                 params, min_elements=config.quantize_min_elements)
         else:
             served = jax.tree_util.tree_map(jnp.asarray, params)
-        self._served = jax.device_put(served, rep)
+        if model_n > 1:
+            # multi-chip serving of big models (ISSUE 13 satellite): the
+            # served weights shard per the model's GSPMD rules — XLA
+            # inserts the TP collectives into the compiled forwards;
+            # per-device weight residency divides by the model axis
+            from ..parallel.sharding import shard_pytree
+
+            self._served = shard_pytree(served, mesh, rules)
+        else:
+            self._served = jax.device_put(served, rep)
         if jax.tree_util.tree_leaves(self._batch_stats):
             self._batch_stats = jax.device_put(self._batch_stats, rep)
         self._param_dtype = jnp.result_type(
@@ -250,7 +274,7 @@ class InferenceEngine:
             params = (trainer._fsdp_unflatten(state.params)
                       if trainer._fsdp else state.params)
             engine = cls(model, mesh, config, params,
-                         batch_stats=state.batch_stats)
+                         batch_stats=state.batch_stats, rules=rules)
             engine.checkpoint_info = {
                 "dir": str(ckpt_dir),
                 "label": label,

@@ -139,7 +139,7 @@ def summarize(events: List[dict]) -> dict:
                 counters["samples"] / counters["epoch_time_s"], 2),
         }
     for key in ("wire_bytes_per_replica", "fsdp_gather_bytes",
-                "exposed_comm_pct"):
+                "tp_psum_bytes_per_replica", "exposed_comm_pct"):
         if key in counters:
             out.setdefault("wire", {})[key] = counters[key]
         elif key in gauges:

@@ -49,7 +49,9 @@ from ..parallel.grad_sync import (
 from ..parallel.mesh import BATCH_AXES, MODEL, batch_shard_count
 from ..parallel.sharding import (
     PartitionRules, batch_spec, dp_flat_specs, feasible_spec,
-    flatten_pad, fsdp_flat_params, shard_pytree, tree_specs,
+    flatten_pad, fsdp_flat_params, fsdp_tp_flat_params, shard_pytree,
+    tp_flat_leaf, tp_local_struct, tp_split_dims, tp_unflatten_leaf,
+    tree_specs,
 )
 from ..utils.logging import log_main
 from ..utils.metrics import ThroughputMeter
@@ -225,7 +227,15 @@ class Trainer:
         self._zero1_n = batch_shard_count(mesh)
         multi = self._zero1_n > 1
         model_n = mesh.shape.get(MODEL, 1)
-        self._fsdp = bool(config.fsdp_explicit) and multi
+        # Explicit TP x FSDP (ISSUE 13): on a 2-D ("data","model") mesh the
+        # fsdp step runs megatron column/row-split blocks inside the SAME
+        # shard_map (one psum over `model` per residual join); the
+        # per-layer gathers/scatters ride the data axes only, over the
+        # TP-LOCAL parameter slices — wire bytes drop 1/M per replica.
+        # Params + both AdamW moments live flat-sharded 1/(N*M) at rest
+        # (model-major flat layout, parallel/sharding.py tp_flat_leaf).
+        self._fsdp = bool(config.fsdp_explicit) and (multi or model_n > 1)
+        self._tp_n = model_n if (self._fsdp and model_n > 1) else 1
         # zero1 x TP (the per-leaf composition): on meshes with a model
         # axis the manual shard_map path cannot run (the TP layers need
         # GSPMD inside the body, and jax 0.4.x partial-auto shard_map
@@ -244,6 +254,14 @@ class Trainer:
         self._fsdp_plan = None
         self._fsdp_template = None
         self._fsdp_sizes = None
+        # explicit-TP state (built by init_state when _tp_n > 1): the
+        # per-leaf model-axis split dims (tp_fsdp_rules read as layout),
+        # the TP-local model clone whose apply the step body runs, and the
+        # TP-local ShapeDtypeStruct template the per-layer gather
+        # unflattens against
+        self._tp_split_dims = None
+        self._tp_model = None
+        self._fsdp_local_template = None
         if config.zero1 or config.fsdp_explicit or explicit_sync:
             # These modes run the step in a shard_map over the batch axes
             # (zero1/grad_sync with replicated parameters, fsdp_explicit
@@ -252,7 +270,8 @@ class Trainer:
             mode = ("fsdp_explicit" if config.fsdp_explicit
                     else "zero1" if config.zero1
                     else "grad_sync (bucket_cap_mb/wire_dtype)")
-            allowed = {MODEL} if config.zero1 else set()
+            allowed = ({MODEL} if (config.zero1 or config.fsdp_explicit)
+                       else set())
             bad = sorted(a for a, s in mesh.shape.items()
                          if s > 1 and a not in BATCH_AXES
                          and a not in allowed)
@@ -261,15 +280,18 @@ class Trainer:
                     f"{mode} runs gradient sync over the data-parallel "
                     f"axes {BATCH_AXES}; mesh axes {bad} > 1 need the "
                     "implicit path (SP/PP/EP collectives are per-layer, "
-                    "not per-update; only zero1 composes with a model "
-                    "axis, via the per-leaf GSPMD update)")
+                    "not per-update; only zero1 and fsdp_explicit compose "
+                    "with a model axis — zero1 via the per-leaf GSPMD "
+                    "update, fsdp_explicit via explicit megatron TP)")
             if self._zero1_gspmd and config.wire_dtype != "fp32":
                 raise ValueError(
                     "zero1 on a model-axis mesh runs the GSPMD sharded "
                     "update, where the scatter/gather are layout "
-                    "constraints, not explicit collectives — wire "
-                    "compression needs the manual shard_map path (a pure "
-                    "data-parallel mesh); use wire_dtype='fp32' here")
+                    "constraints, not explicit collectives the codecs "
+                    "could wrap — a compressed wire on a model-axis mesh "
+                    "is --fsdp-explicit's job (explicit TP x FSDP owns "
+                    "its wire layout end to end; PARITY.md records this "
+                    "path as subsumed); use wire_dtype='fp32' here")
             if rules is not None:
                 conflict = sorted(
                     rules.axes_used()
@@ -294,7 +316,7 @@ class Trainer:
                 log_main("NOTE: zero1 requested on a single batch shard — "
                          "running the replicated update (identity "
                          "passthrough, like single-process DDP)")
-            if config.fsdp_explicit and not multi:
+            if config.fsdp_explicit and not multi and model_n <= 1:
                 log_main("NOTE: fsdp_explicit requested on a single batch "
                          "shard — nothing to shard; running the "
                          "replicated update (identity passthrough)")
@@ -319,6 +341,58 @@ class Trainer:
         independent, so a resharded restore replays the same trajectory
         behind the same step fence."""
         return self._zero1_n
+
+    def tp_expected_model_collectives(self) -> Tuple[int, int]:
+        """(model-axis psums, model-axis gathers) one explicit-TP train
+        step legitimately spends — the `tp-psum-signature` rule's budget
+        (analysis/hlo_rules.py), derived from the TP model: per block, one
+        psum per residual join in the forward (attention out + MLP out)
+        and one backward psum per parallel-region input — 4 per block —
+        plus the vocab-parallel embedding's lookup psum + head-input
+        backward psum and its one logits all-gather when engaged.
+        (0, 0) when explicit TP is not engaged."""
+        if self._tp_n <= 1 or self._tp_model is None:
+            return (0, 0)
+        depth = getattr(self._tp_model, "depth", None)
+        if depth is None:
+            return (0, 0)
+        tp_vocab = bool(getattr(self._tp_model, "tp_vocab", False))
+        return (4 * depth + (2 if tp_vocab else 0), 1 if tp_vocab else 0)
+
+    def tp_wire_bytes(self, local_batch: int, seq_len: int) -> int:
+        """Per-replica model-axis wire bytes of one explicit-TP step
+        (`grad_sync.tp_psum_bytes_per_step` fed from the TP model) — the
+        TP tier term train.py and the bench harness emit. 0 when explicit
+        TP is not engaged."""
+        from ..parallel.grad_sync import tp_psum_bytes_per_step
+
+        if self._tp_n <= 1 or self._tp_model is None:
+            return 0
+        m = self._tp_model
+        if getattr(m, "depth", None) is None:
+            return 0
+        return tp_psum_bytes_per_step(
+            m.hidden_dim, m.depth, local_batch, seq_len, self._tp_n,
+            tp_vocab=bool(getattr(m, "tp_vocab", False)),
+            padded_vocab=getattr(m, "padded_vocab", 0))
+
+    def wire_accounting_inputs(self, state: TrainState, base_cfg: dict,
+                               global_batch: int, seq_len: int):
+        """(params, cfg) for `grad_sync.emit_wire_accounting` — THE one
+        assembly both train.py and the bench harness use, so their rows
+        cannot drift. Under explicit TP the data-axis terms come from the
+        TP-LOCAL template (each model shard gathers/scatters only its 1/M
+        slice) and the model-axis activation bytes ride ``tp_psum_bytes``
+        (their own telemetry tier row); 1-D configs pass through
+        unchanged."""
+        cfg = dict(base_cfg)
+        params = state.params
+        if self._tp_n > 1:
+            params = self._fsdp_local_template
+            cfg["model_shards"] = self._tp_n
+            cfg["tp_psum_bytes"] = self.tp_wire_bytes(
+                global_batch // self._zero1_n, seq_len)
+        return params, cfg
 
     def set_mfu_reference(self, flops_per_sample: float,
                           peak_flops_total: float) -> None:
@@ -892,12 +966,29 @@ class Trainer:
         """Model-shaped params from the flat-sharded at-rest layout via
         plain reshape/slice ops — OUTSIDE shard_map (eval, diagnostics)
         GSPMD inserts the gathers; inside the step the per-layer gather
-        does it explicitly."""
+        does it explicitly. Under explicit TP the at-rest layout is
+        model-major (per-shard slices concatenated): split leaves
+        re-concatenate along their split dim, replicated leaves take
+        copy 0 (all copies bit-identical by construction)."""
         if self._fsdp_template is None:
             raise ValueError(
                 "fsdp_explicit state has no unflatten template — build "
                 "the state via Trainer.init_state (the flat leaves alone "
                 "cannot recover the model shapes)")
+        if self._tp_n > 1:
+            from jax.sharding import NamedSharding
+
+            # Replicate each flat leaf FIRST: jax 0.4.x GSPMD miscompiles
+            # the reshape/slice/concat chain on an input whose dim 0 is
+            # sharded over a multi-name axis tuple (wrong data movement,
+            # found empirically) — an explicit resharding to replicated is
+            # handled correctly and is work the unflatten forces anyway.
+            rep = NamedSharding(self.mesh, P())
+            return jax.tree_util.tree_map(
+                lambda f, t, d: tp_unflatten_leaf(
+                    lax.with_sharding_constraint(f, rep), t.shape, t.dtype,
+                    d, self._tp_n),
+                flat_params, self._fsdp_template, self._tp_split_dims)
         return jax.tree_util.tree_map(
             lambda f, t: f[:int(np.prod(t.shape) or 1)]
             .reshape(t.shape).astype(t.dtype),
@@ -931,7 +1022,11 @@ class Trainer:
         parameters; convergence pinned, not parity).
         """
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
-        axes = BATCH_AXES
+        axes = BATCH_AXES  # the FSDP wire: gathers/scatters ride data only
+        tp = self._tp_n
+        # explicit TP: the model axis joins the shard_map (megatron psums
+        # bind it); the at-rest dim-0 layout is model-major
+        axes_all = ((MODEL,) + BATCH_AXES) if tp > 1 else BATCH_AXES
         task, cfg = self.task, self.config
         wire = cfg.wire_dtype
         fusedq = cfg.fused_quantize  # tri-state, resolved at trace
@@ -958,16 +1053,23 @@ class Trainer:
                         "— the state was built for a different model/mesh; "
                         "rebuild via Trainer.init_state")
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
-        outer = state  # static fields (apply_fn/tx) for the inner rebuild
-        template_leaves = jax.tree_util.tree_leaves(self._fsdp_template)
-        treedef = jax.tree_util.tree_structure(self._fsdp_template)
+        if tp > 1:
+            # the body computes with the TP-local model (megatron
+            # column/row split, model-axis psums via the custom_vjp f/g
+            # operators in parallel/collectives.py)
+            outer = state.replace(apply_fn=self._tp_model.apply)
+        else:
+            outer = state  # static fields (apply_fn/tx) for inner rebuild
+        local_template = self._fsdp_local_template
+        template_leaves = jax.tree_util.tree_leaves(local_template)
+        treedef = jax.tree_util.tree_structure(local_template)
         leaf_sizes = self._fsdp_sizes  # host-precomputed (init_state)
 
         rep = P()
         batch_specs = jax.tree_util.tree_map(
             lambda x: batch_spec(jnp.ndim(x)), batch)
-        param_specs = dp_flat_specs(state.params)
-        opt_specs = dp_flat_specs(state.opt_state)
+        param_specs = dp_flat_specs(state.params, axes=axes_all)
+        opt_specs = dp_flat_specs(state.opt_state, axes=axes_all)
 
         def body(p_shards, opt_state, stats, lbatch, key, step, *maybe_ef):
             idx = lax.axis_index(axes)
@@ -1121,7 +1223,7 @@ class Trainer:
         args = [state.params, state.opt_state, state.batch_stats, batch,
                 rng, state.step]
         if use_ef:
-            ef_specs = jax.tree_util.tree_map(lambda _: P(axes),
+            ef_specs = jax.tree_util.tree_map(lambda _: P(axes_all),
                                               state.grad_sync["ef"])
             in_specs += (ef_specs,)
             out_specs += (ef_specs,)
@@ -1174,21 +1276,72 @@ class Trainer:
             # padded layout, 1/N per replica at rest — the at-rest memory
             # division that is the mode's point. The model-shaped template
             # (shapes/dtypes only, host-side) is what the step's per-layer
-            # gather unflattens against.
+            # gather unflattens against. With a model axis (explicit TP,
+            # ISSUE 13) the layout is model-major: each leaf's TP-local
+            # slice (or full copy, for model-replicated leaves) flat-padded
+            # per model shard — 1/(N*M) at rest for every TP-split tensor.
             from .optim import zero1_opt_state
 
+            n, tp = self._zero1_n, self._tp_n
             self._fsdp_template = jax.tree_util.tree_map(
                 lambda p: jax.ShapeDtypeStruct(jnp.shape(p),
                                                jnp.result_type(p)), params)
+            if tp > 1:
+                import dataclasses as _dc
+
+                field_names = {f.name for f in _dc.fields(type(model))}
+                if not {"tp_size", "tp_axis"} <= field_names:
+                    raise ValueError(
+                        f"mesh has model={tp} under fsdp_explicit, but "
+                        f"{type(model).__name__} has no explicit-TP form "
+                        "(tp_size/tp_axis fields) — gpt2_* models support "
+                        "explicit TP; others need a 1-D mesh or the "
+                        "implicit GSPMD path")
+                heads = getattr(model, "num_heads", None)
+                if heads is not None and heads % tp:
+                    # the TP module raises the same at trace time; failing
+                    # here keeps the error at state construction
+                    raise ValueError(
+                        f"num_heads={heads} not divisible by the mesh's "
+                        f"model={tp} — explicit TP splits attention by "
+                        "whole heads")
+                rules = self.rules
+                if rules is None and hasattr(type(model), "partition_rules"):
+                    rules = type(model).partition_rules()
+                if rules is None:
+                    raise ValueError(
+                        "explicit TP derives its layout from the model's "
+                        "partition rules (tp_fsdp_rules) — pass rules= or "
+                        "give the model a partition_rules() classmethod")
+                self._tp_split_dims = tp_split_dims(self._fsdp_template,
+                                                    rules, tp)
+                self._tp_model = model.clone(tp_size=tp, tp_axis=MODEL)
+                local_template = tp_local_struct(self._fsdp_template,
+                                                 self._tp_split_dims, tp)
+            else:
+                local_template = self._fsdp_template
+            self._fsdp_local_template = local_template
             # host-side leaf sizes (tree_leaves order) for the in-step
             # unflatten slicing — precomputed here so the traced step does
             # no int() shape math (the no-host-sync-in-step lint's scope)
             self._fsdp_sizes = tuple(
                 int(np.prod(t.shape) or 1) for t in
-                jax.tree_util.tree_leaves(self._fsdp_template))
-            self._fsdp_plan = build_layer_plan(params, self._zero1_n)
-            opt_state = zero1_opt_state(tx, params, self.mesh)
-            flat_params = fsdp_flat_params(params, self.mesh, self._zero1_n)
+                jax.tree_util.tree_leaves(local_template))
+            self._fsdp_plan = build_layer_plan(local_template, n)
+            if tp > 1:
+                axes_all = (MODEL,) + BATCH_AXES
+                split_dims = self._tp_split_dims
+                opt_state = zero1_opt_state(
+                    tx, params, self.mesh,
+                    flatten_tree_fn=lambda p: jax.tree_util.tree_map(
+                        lambda x, d: tp_flat_leaf(x, d, tp, n),
+                        p, split_dims),
+                    axes=axes_all)
+                flat_params = fsdp_tp_flat_params(
+                    params, self.mesh, n, tp, split_dims, axes_all)
+            else:
+                opt_state = zero1_opt_state(tx, params, self.mesh)
+                flat_params = fsdp_flat_params(params, self.mesh, n)
             state = TrainState.create(
                 apply_fn=model.apply, params=params, tx=tx,
                 batch_stats=batch_stats, opt_state=opt_state)
@@ -1197,7 +1350,7 @@ class Trainer:
             placed = placed.replace(params=flat_params, opt_state=opt_state)
             if use_ef:
                 placed = placed.replace(grad_sync=ef_state_fsdp(
-                    params, self.mesh, self._zero1_n))
+                    local_template, self.mesh, n, model_n=tp))
             return placed
         if self._zero1 or self._zero1_gspmd:
             # Params stay replicated (the DDP layout — zero1 shards only

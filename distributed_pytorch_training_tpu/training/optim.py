@@ -20,6 +20,7 @@ Schedule = Union[float, optax.Schedule]
 
 def clip_by_global_norm_dp(
     max_norm: float, axis_names: Optional[Sequence[str]] = None,
+    leaf_weights: Optional[dict] = None,
 ) -> optax.GradientTransformation:
     """``optax.clip_by_global_norm`` whose norm is psum'd over mesh axes.
 
@@ -31,6 +32,15 @@ def clip_by_global_norm_dp(
     identically. With ``axis_names=None`` this IS the stock transform (the
     single-device passthrough convention of parallel/collectives.py).
     Usable only inside a context that binds the axis names (shard_map).
+
+    ``leaf_weights`` (explicit TP x FSDP, ISSUE 13): {'/'-joined leaf
+    path: weight} multiplying each leaf's SQUARED contribution before the
+    psum. The TP at-rest layout stores model-replicated leaves once per
+    model shard, so a psum over (model,) + batch axes counts them M times;
+    `parallel.sharding.tp_clip_weights` assigns those leaves 1/M (and
+    TP-split leaves 1) so the recovered norm is the exact global one.
+    Every leaf path must be present — a missing path is a loud KeyError,
+    never a silently mis-weighted norm.
     """
     if not axis_names:
         return optax.clip_by_global_norm(max_norm)
@@ -40,8 +50,15 @@ def clip_by_global_norm_dp(
 
     def update_fn(updates, state, params=None):
         del params
-        sq = sum(jnp.sum(jnp.square(u))
-                 for u in jax.tree_util.tree_leaves(updates))
+        if leaf_weights is None:
+            sq = sum(jnp.sum(jnp.square(u))
+                     for u in jax.tree_util.tree_leaves(updates))
+        else:
+            from ..parallel.sharding import _path_str
+
+            sq = sum(
+                leaf_weights[_path_str(path)] * jnp.sum(jnp.square(u))
+                for path, u in jax.tree_util.tree_leaves_with_path(updates))
         g_norm = jnp.sqrt(jax.lax.psum(sq, tuple(axis_names)))
         # mirror optax.clip_by_global_norm exactly (select, not clamp) so
         # the parity with the replicated path is bit-for-bit in fp32
@@ -107,6 +124,7 @@ def adamw(
     weight_decay: float = 0.01,
     grad_clip_norm: Optional[float] = 1.0,
     shard_axes: Optional[Sequence[str]] = None,
+    clip_leaf_weights: Optional[dict] = None,
 ) -> optax.GradientTransformation:
     """AdamW for BERT/GPT-2 (BASELINE.json:11-12); decoupled weight decay,
     optional global-norm clipping (standard for LM training).
@@ -114,11 +132,13 @@ def adamw(
     ``shard_axes``: mesh axis names the ZeRO-1 update shards gradients over
     — the clip's global norm is then psum'd across them (every other part of
     the chain is elementwise and shard-oblivious). Leave None for the
-    replicated path.
+    replicated path. ``clip_leaf_weights`` — the explicit-TP duplication
+    weights (see `clip_by_global_norm_dp`).
     """
     parts = []
     if grad_clip_norm:
-        parts.append(clip_by_global_norm_dp(grad_clip_norm, shard_axes))
+        parts.append(clip_by_global_norm_dp(grad_clip_norm, shard_axes,
+                                            leaf_weights=clip_leaf_weights))
     parts.append(optax.scale_by_adam(b1=b1, b2=b2, eps=eps))
     if weight_decay:
         parts.append(optax.add_decayed_weights(weight_decay))
@@ -133,20 +153,23 @@ def make_optimizer(
     weight_decay: float = 5e-4,
     grad_clip_norm: Optional[float] = None,
     shard_axes: Optional[Sequence[str]] = None,
+    clip_leaf_weights: Optional[dict] = None,
 ) -> optax.GradientTransformation:
     """Optimizer factory keyed by CLI name (the reference hardcodes SGD,
-    ref :339; transformers need AdamW). ``shard_axes`` — see `adamw`; SGD's
-    chain is fully elementwise, so it needs no shard awareness."""
+    ref :339; transformers need AdamW). ``shard_axes`` /
+    ``clip_leaf_weights`` — see `adamw`; SGD's chain is fully elementwise,
+    so it needs no shard awareness."""
     if name == "sgd":
         return sgd(learning_rate, momentum=momentum, weight_decay=weight_decay)
     if name == "adamw":
         return adamw(learning_rate, weight_decay=weight_decay,
-                     grad_clip_norm=grad_clip_norm, shard_axes=shard_axes)
+                     grad_clip_norm=grad_clip_norm, shard_axes=shard_axes,
+                     clip_leaf_weights=clip_leaf_weights)
     raise ValueError(f"unknown optimizer {name!r} (sgd, adamw)")
 
 
 def zero1_opt_state(tx: optax.GradientTransformation, params,
-                    mesh) -> "tuple":
+                    mesh, flatten_tree_fn=None, axes=None) -> "tuple":
     """Optimizer state for the sharded weight update: moments are born in
     the flat-padded-sharded layout (parallel/sharding.py `flatten_pad`),
     each replica materializing ONLY its 1/N chunk — the optimizer-memory
@@ -157,6 +180,11 @@ def zero1_opt_state(tx: optax.GradientTransformation, params,
     manual zero1 shard_map path, the zero1 x TP GSPMD composition, and
     explicit FSDP (`fsdp_explicit`, which additionally stores the PARAMS
     in the same flat layout — parallel/sharding.py `fsdp_flat_params`).
+
+    ``flatten_tree_fn``/``axes`` override the flat layout and the dim-0
+    sharding axes — explicit TP x FSDP passes the model-major
+    `tp_flat_leaf` layout and (model,) + batch axes, so moments are born
+    1/(N*M) for every TP-split leaf.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -165,12 +193,16 @@ def zero1_opt_state(tx: optax.GradientTransformation, params,
     from ..parallel.sharding import dp_flat_specs, flatten_pad
 
     n = batch_shard_count(mesh)
+    if flatten_tree_fn is None:
+        def flatten_tree_fn(p):
+            return jax.tree_util.tree_map(
+                lambda leaf: flatten_pad(leaf, n), p)
 
     def init(params):
-        flat = jax.tree_util.tree_map(lambda p: flatten_pad(p, n), params)
-        return tx.init(flat)
+        return tx.init(flatten_tree_fn(params))
 
-    specs = dp_flat_specs(jax.eval_shape(init, params))
+    specs = dp_flat_specs(jax.eval_shape(init, params),
+                          *(() if axes is None else (tuple(axes),)))
     shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs)
     return jax.jit(init, out_shardings=shardings)(params)

@@ -24,12 +24,14 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "gsync_bf16_accum", "gsync_int8_mh",
                              "gsync_int8_mh_accum", "gsync_int8_mh_fused",
                              "fsdp", "fsdp_accum", "fsdp_int8_mh",
+                             "fsdp_tp", "fsdp_tp_int8_mh",
                              "serving_decode", "elastic_reshard",
                              "elastic_grow"}
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran, incl. the fsdp rules (ISSUE 7), the
-    # serving decode-step rules (ISSUE 10) and the elastic census pins in
-    # BOTH directions (ISSUEs 11 + 12)
+    # serving decode-step rules (ISSUE 10), the elastic census pins in
+    # BOTH directions (ISSUEs 11 + 12), and the 2-D TP x FSDP rules
+    # (ISSUE 13)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
     assert "fsdp-layer-gather-bound" in kinds
@@ -37,6 +39,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "no-host-sync-in-decode" in kinds
     assert "elastic-reshard-census" in kinds
     assert "elastic-grow-census" in kinds
+    assert "tp-psum-signature" in kinds
+    assert "fsdp-gather-rides-data-only" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

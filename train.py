@@ -443,14 +443,32 @@ def _run(args, guard):
     # The zero1 x model-axis composition runs the GSPMD update on GLOBAL
     # flat arrays (training/loop.py), so its clip stays stock too.
     model_axis = mesh.shape.get("model", 1) > 1
+    # Explicit TP x FSDP (ISSUE 13): the update shards over
+    # (model,) + batch axes — the clip's norm psum must ride all three,
+    # with model-replicated leaves down-weighted 1/M (they are stored once
+    # per model shard; parallel/sharding.tp_clip_weights).
+    explicit_tp = args.fsdp_explicit and model_axis
     sharded_update = ((args.zero1 and not model_axis) or args.fsdp_explicit) \
-        and n_batch_shards > 1
-    tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
-                        weight_decay=args.weight_decay,
-                        shard_axes=BATCH_AXES if sharded_update else None)
-
+        and (n_batch_shards > 1 or explicit_tp)
+    shard_axes = None
+    clip_weights = None
     rules = (type(model).partition_rules()
              if hasattr(type(model), "partition_rules") else None)
+    if sharded_update:
+        from distributed_pytorch_training_tpu.parallel.mesh import MODEL
+        shard_axes = ((MODEL,) + BATCH_AXES) if explicit_tp else BATCH_AXES
+    if explicit_tp and rules is not None:
+        from distributed_pytorch_training_tpu.parallel.sharding import (
+            tp_clip_weights_for_model,
+        )
+        clip_weights = tp_clip_weights_for_model(
+            model, rules, mesh.shape["model"],
+            np.zeros((mesh.shape["model"],) + tuple(sample_input.shape[1:]),
+                     np.asarray(sample_input).dtype))
+    tx = make_optimizer(args.optimizer, schedule, momentum=args.momentum,
+                        weight_decay=args.weight_decay,
+                        shard_axes=shard_axes,
+                        clip_leaf_weights=clip_weights)
     # Refuse silently-wasted devices: every mesh axis > 1 must be one the
     # selected model/attention combination can actually use.
     validate_mesh_usage(mesh, rules=rules,
@@ -471,7 +489,17 @@ def _run(args, guard):
                                                   "off": False}[
                                                       args.fused_quantize]),
                       rules=rules)
-    if args.fsdp_explicit and n_batch_shards > 1:
+    if explicit_tp:
+        log_main(f"TP x FSDP (explicit): megatron tensor parallelism over "
+                 f"model={mesh.shape['model']} inside the FSDP shard_map "
+                 f"(one psum per residual join); params + moments "
+                 f"flat-sharded 1/{n_batch_shards * mesh.shape['model']} "
+                 "at rest for TP-split tensors; per-layer gathers/scatters "
+                 "ride the data axes over each shard's 1/"
+                 f"{mesh.shape['model']} slice"
+                 + (f"; {args.wire_dtype} wire" if args.wire_dtype != "fp32"
+                    else ""))
+    elif args.fsdp_explicit and n_batch_shards > 1:
         log_main(f"FSDP (explicit): params + moments flat-sharded "
                  f"{n_batch_shards}-way at rest; per-layer just-in-time "
                  "param gathers, gradients reduce-scattered into the shard "
@@ -539,13 +567,17 @@ def _run(args, guard):
             emit_wire_accounting,
         )
         # fsdp states hold flat-sharded leaves; their padded totals match
-        # the model-shaped ones (the harness records them the same way)
-        emit_wire_accounting(
-            state.params,
-            dict(wire_dtype=args.wire_dtype,
-                 bucket_cap_mb=args.bucket_cap_mb,
-                 fsdp_explicit=args.fsdp_explicit),
-            n_batch_shards)
+        # the model-shaped ones (the harness records them the same way).
+        # Explicit TP: the data-axis terms come from the TP-LOCAL template
+        # (each model shard gathers/scatters its slice only — the 1/M
+        # reduction), and the model-axis psum bytes land in their own
+        # tier row (axis="model") so `telemetry summary` splits them.
+        acct_params, acct_cfg = trainer.wire_accounting_inputs(
+            state, dict(wire_dtype=args.wire_dtype,
+                        bucket_cap_mb=args.bucket_cap_mb,
+                        fsdp_explicit=args.fsdp_explicit),
+            global_batch, seq_len if is_lm else 0)
+        emit_wire_accounting(acct_params, acct_cfg, n_batch_shards)
 
     # MFU in the step log (TPU only — needs a known chip peak): analytic
     # matmul/conv FLOPs of one train step, traced once on a peeked batch.
