@@ -623,6 +623,96 @@ def check_telemetry_in_traced(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule 8: every emitted span name is registered
+# ---------------------------------------------------------------------------
+
+# The emission helpers whose first argument is a span NAME (module-level
+# `telemetry.span(...)` / `telemetry.span_event(...)` and their member
+# imports — the only in-repo emission idioms; `Recorder.emit("span", ...)`
+# stays internal to the telemetry package).
+_SPAN_EMITTERS = frozenset({"span", "span_event"})
+
+
+def _registered_span_names() -> frozenset:
+    # telemetry/recorder.py is jax-free by contract (the engine's no-
+    # backend rule holds), so unlike AXIS_NAMES the registry is imported,
+    # not mirrored — one definition, nothing to drift.
+    from ..telemetry.recorder import REGISTERED_SPAN_NAMES
+
+    return frozenset(REGISTERED_SPAN_NAMES)
+
+
+@rule("span-names-registered", "ast",
+      "every telemetry span name emitted in-repo appears in the "
+      "recorder's span-name registry",
+      "`telemetry summary` buckets spans by NAME against the canonical "
+      "registry (SPAN_NAMES / SERVING_SPAN_NAMES / ELASTIC_SPAN_NAMES / "
+      "AUX_SPAN_NAMES in telemetry/recorder.py) and silently files "
+      "anything else under 'unaccounted' — a typo'd or unregistered span "
+      "name vanishes from the step-time split instead of failing loudly, "
+      "and the fleet aggregator's phase attribution never sees it. New "
+      "span names are one registry line away; dynamic (non-literal) "
+      "names are flagged too, because a name the linter cannot read is a "
+      "name the registry cannot vouch for.")
+def check_span_names_registered(ctx: FileContext) -> List[Finding]:
+    mods, members, dotted = _telemetry_bindings(ctx)
+    if not mods and not members and not dotted:
+        return []
+    # local names bound to the emitters via member imports, ALIASES
+    # included: `from ..telemetry import span_event as se` binds `se` to
+    # span_event — _telemetry_bindings keeps only the bound name, so the
+    # original-name mapping is re-derived here (the pallas rule's
+    # alias-aware convention)
+    member_emitters: Dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and _TELEMETRY_MODULE in (node.module or "").split("."):
+            for a in node.names:
+                if a.name in _SPAN_EMITTERS:
+                    member_emitters[a.asname or a.name] = a.name
+    registry = _registered_span_names()
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        emitter = None
+        if isinstance(func, ast.Attribute) and func.attr in _SPAN_EMITTERS:
+            head = func
+            while isinstance(head, ast.Attribute):
+                head = head.value
+            if isinstance(head, ast.Name) and head.id in mods:
+                emitter = func.attr
+            elif dotted:
+                raw = _raw_dotted(func)
+                if raw and any(raw.startswith(d + ".") for d in dotted):
+                    emitter = func.attr
+        elif isinstance(func, ast.Name) and func.id in member_emitters:
+            emitter = member_emitters[func.id]
+        if emitter is None or not node.args:
+            continue
+        name_arg = node.args[0]
+        if isinstance(name_arg, ast.Constant) \
+                and isinstance(name_arg.value, str):
+            if name_arg.value not in registry:
+                out.append(Finding(
+                    "span-names-registered",
+                    f"span name {name_arg.value!r} in {emitter}(...) is "
+                    "not in the telemetry span-name registry — "
+                    "`telemetry summary` would bucket it into "
+                    "'unaccounted'; add it to the right *_SPAN_NAMES "
+                    "tuple in telemetry/recorder.py", ctx.loc(name_arg)))
+        else:
+            out.append(Finding(
+                "span-names-registered",
+                f"dynamic span name in {emitter}(...) — the registry "
+                "cannot vouch for a name the linter cannot read; emit a "
+                "registered literal (or suppress on this line if the "
+                "dynamism is deliberate)", ctx.loc(name_arg)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 

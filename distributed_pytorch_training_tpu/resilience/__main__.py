@@ -211,6 +211,12 @@ def _add_fleet_args(p: argparse.ArgumentParser) -> None:
                         "SIGTERM shortly before the end)")
     p.add_argument("--max-launches", type=int, default=8,
                    help="fleet: launch budget before giving up")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="fleet: stamp DPT_METRICS_PORT (+rank) into "
+                        "every child so each serves live /metrics + "
+                        "/healthz; the orchestrator smoke-scrapes it "
+                        "while children run (telemetry/metrics_http.py). "
+                        "Default off")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -115,6 +115,9 @@ class CapacityWatch:
         :attr:`returned`."""
         with _telemetry.span("capacity_watch", world=current_world):
             avail = self.available()
+            # the /metrics capacity gauge: every boundary poll publishes
+            # what the fleet registry currently believes is available
+            _telemetry.gauge("capacity_available", avail)
             self.returned.clear()
             if current_world is None or avail <= current_world:
                 return None

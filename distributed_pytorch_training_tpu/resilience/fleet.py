@@ -48,7 +48,16 @@ import time
 from pathlib import Path
 from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
+from ..telemetry.aggregate import (
+    StreamFollower,
+    aggregate_segments,
+    last_step_of,
+    split_streams,
+    stitch_perfetto,
+)
 from ..telemetry.flight import FLEET_GENERATION_ENV, FLEET_RANK_ENV
+from ..telemetry.metrics_http import METRICS_PORT_ENV
+from ..telemetry.recorder import stream_filename
 from .elastic import plan_elastic_world
 from .heartbeat import DEATHWATCH_EXIT_CODE
 
@@ -115,6 +124,13 @@ class FleetLaunch:
     outcome: str = "launched"   # completed | drained | crashed | relay_death
     step_after: int = -1
     log_path: str = ""
+    # live observability (ISSUE 14): the largest step seen in the child's
+    # telemetry stream WHILE it ran (the tail thread's progress probe),
+    # and the /metrics smoke verdict when a metrics port was stamped
+    # (None = no port / never scrapeable before exit)
+    live_last_step: int = -1
+    metrics_scrapes: int = 0
+    metrics_ok: Optional[bool] = None
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -153,6 +169,15 @@ class FleetOrchestrator:
     control. ``set_child_devices=True`` pins each child to a CPU mesh of
     exactly ``world`` virtual devices (JAX_PLATFORMS=cpu + XLA_FLAGS);
     pass False when ``argv_for`` manages the child environment itself.
+
+    Live observability (ISSUE 14): ``telemetry_dir`` names the directory
+    the children write their telemetry streams into — when set, the
+    orchestrator TAILS the per-rank stream while each child runs and
+    logs per-generation progress lines (``gen G live — step S``), so a
+    fleet run is watchable without attaching to any child.
+    ``metrics_port`` stamps ``DPT_METRICS_PORT`` (+rank offset) into the
+    child env so every child serves /metrics + /healthz, and the watch
+    loop smoke-scrapes it (``launch.metrics_ok``).
     """
 
     def __init__(self, argv_for: Callable[..., List[str]], ckpt_dir,
@@ -163,6 +188,9 @@ class FleetOrchestrator:
                  set_child_devices: bool = True,
                  on_child_exit: Optional[Callable[..., None]] = None,
                  log_dir=None,
+                 telemetry_dir=None,
+                 metrics_port: Optional[int] = None,
+                 progress_poll_s: float = 0.5,
                  log: Callable[[str], None] = _stderr_log):
         if max_launches < 1:
             raise ValueError(f"max_launches must be >= 1, "
@@ -179,6 +207,10 @@ class FleetOrchestrator:
         self.on_child_exit = on_child_exit
         self.log_dir = Path(log_dir) if log_dir is not None \
             else self.ckpt_dir / "fleet_logs"
+        self.telemetry_dir = (Path(telemetry_dir)
+                              if telemetry_dir is not None else None)
+        self.metrics_port = metrics_port
+        self.progress_poll_s = float(progress_poll_s)
         self.log = log
 
     @staticmethod
@@ -192,11 +224,18 @@ class FleetOrchestrator:
 
         return feed
 
-    def _child_env(self, world: int, generation: int) -> Dict[str, str]:
+    def _child_env(self, world: int, generation: int,
+                   rank: int = 0) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(self.env_extra)
         env[FLEET_GENERATION_ENV] = str(generation)
-        env[FLEET_RANK_ENV] = "0"
+        env[FLEET_RANK_ENV] = str(rank)
+        if self.metrics_port:
+            # stamp the BASE port: the child applies its own rank offset
+            # (resolve_metrics_port reads DPT_FLEET_RANK), so stamping
+            # base+rank here would offset twice — co-hosted ranks get
+            # base+0, base+1, ... from one stamped value
+            env[METRICS_PORT_ENV] = str(int(self.metrics_port))
         if self.set_child_devices:
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = _xla_flags_for(world,
@@ -210,6 +249,67 @@ class FleetOrchestrator:
         if rc == DEATHWATCH_EXIT_CODE:
             return "relay_death"
         return "crashed"
+
+    def _scrape_metrics(self, port: int) -> Optional[str]:
+        """One best-effort /metrics scrape of a running child (stdlib
+        urllib, sub-second timeout — a child mid-compile simply has no
+        listener yet and that is not an error)."""
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=0.8) as resp:
+                return resp.read().decode("utf-8", errors="replace")
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def _watch_child(self, proc: "subprocess.Popen", launch: FleetLaunch,
+                     generation: int) -> None:
+        """Block until the child exits, tailing its telemetry stream for
+        live per-generation progress lines and smoke-scraping /metrics
+        when a port was stamped. A child with no stream (stub tests,
+        --no-telemetry) just waits — the poll loop costs nothing."""
+        follower = None
+        if self.telemetry_dir is not None:
+            # start at the file's CURRENT end: earlier generations
+            # appended to the same stream, and their steps are not this
+            # child's progress (events are also gen-filtered below — the
+            # seek just avoids re-parsing the whole backlog per child)
+            follower = StreamFollower(self.telemetry_dir
+                                      / stream_filename(0),
+                                      start_at_end=True)
+        # the child listens on base + its rank (resolve_metrics_port);
+        # today's children are single-process rank 0
+        port = (int(self.metrics_port) if self.metrics_port else 0)
+        last_logged = -1
+        while True:
+            try:
+                proc.wait(timeout=self.progress_poll_s)
+                break
+            except subprocess.TimeoutExpired:
+                pass
+            if follower is not None:
+                launch.live_last_step = last_step_of(
+                    follower.poll(), launch.live_last_step,
+                    gen=generation)
+                if launch.live_last_step > last_logged:
+                    last_logged = launch.live_last_step
+                    self.log(f"fleet: generation {generation} live — "
+                             f"step {last_logged + 1}/"
+                             f"{self.target_step} (world {launch.world})")
+            if port:
+                body = self._scrape_metrics(port)
+                if body is not None:
+                    launch.metrics_scrapes += 1
+                    ok = "dpt_steps_total" in body
+                    # the smoke holds once ANY successful scrape carried
+                    # the step counter — later scrapes can only confirm
+                    launch.metrics_ok = bool(launch.metrics_ok) or ok
+        # drain whatever the stream gained between the last poll and exit
+        if follower is not None:
+            launch.live_last_step = last_step_of(
+                follower.poll(), launch.live_last_step, gen=generation)
 
     def run(self) -> FleetReport:
         report = FleetReport(target_step=self.target_step)
@@ -231,9 +331,19 @@ class FleetOrchestrator:
                      + (", --resume" if resume else ", fresh") + ")")
             t0 = time.perf_counter()
             with open(log_path, "wb") as lf:
-                proc = subprocess.run(
+                proc = subprocess.Popen(
                     argv, env=self._child_env(world, generation),
                     stdout=lf, stderr=subprocess.STDOUT)
+                try:
+                    self._watch_child(proc, launch, generation)
+                except BaseException:
+                    # subprocess.run's contract, kept: Ctrl-C (or a
+                    # raising watch callback) must not orphan a running
+                    # training child — it would keep writing the shared
+                    # checkpoint dir and holding the metrics port
+                    proc.kill()
+                    proc.wait()
+                    raise
             launch.rc = proc.returncode
             launch.seconds = round(time.perf_counter() - t0, 3)
             step_after, world_after = checkpoint_progress(self.ckpt_dir)
@@ -326,15 +436,20 @@ def _train_argv(args, world: int, resume: bool, chaos: Optional[str],
 def _parse_gen_chaos(spec: Optional[str], spe: int,
                      target_step: int) -> Dict[int, str]:
     """``"0:crash@step=6;1:sigterm@step=10"`` -> {0: ..., 1: ...}.
-    Default: the canonical kill -> drain schedule — generation 0 crashes
-    mid-epoch-1 (after one epoch checkpoint exists), generation 1 drains
-    on SIGTERM two steps short of the end (a mid-epoch preemption save
-    the full-world relaunch must resume from)."""
+    Default: the canonical kill -> drain -> stall schedule — generation 0
+    crashes mid-epoch-1 (after one epoch checkpoint exists), generation 1
+    drains on SIGTERM two steps short of the end (a mid-epoch preemption
+    save the full-world relaunch must resume from), and generation 2 (the
+    grown full-world finisher) takes a 1.5s ``loader_stall`` the merged
+    fleet summary's straggler detector must rank- AND phase-attribute
+    (ISSUE 14's acceptance probe — the stall is non-fatal, the child
+    still completes)."""
     if spec is None:
         crash_at = spe + max(1, spe // 2)
         drain_at = max(crash_at + 1, target_step - spe + 1)
         return {0: f"crash@step={crash_at}",
-                1: f"sigterm@step={drain_at}"}
+                1: f"sigterm@step={drain_at}",
+                2: "loader_stall@step=2:1.5s"}
     out: Dict[int, str] = {}
     for item in filter(None, (s.strip() for s in spec.split(";"))):
         gen_s, _, chaos = item.partition(":")
@@ -477,14 +592,71 @@ def fleet_main(args) -> int:
             str(ckpt_dir), str(out_dir)),
         ckpt_dir, global_batch=args.global_batch,
         target_step=target_step, capacity_for=capacity,
-        max_launches=args.max_launches, on_child_exit=snapshot)
+        max_launches=args.max_launches, on_child_exit=snapshot,
+        telemetry_dir=out_dir,
+        metrics_port=getattr(args, "metrics_port", None))
     # flights already present belong to a PREVIOUS fleet run over this
     # --ckpt-dir — excluded from this run's per-generation accounting
     pre_existing_flights = set(Path(out_dir).glob("flight_*.json"))
+    # ... and so do telemetry streams: children APPEND to the shared
+    # per-rank file, so a reused --ckpt-dir would fold the previous
+    # run's segments into THIS run's merged summary, trace, and
+    # straggler verdict (a stale loader_stall row could satisfy the
+    # acceptance probe). Rotate them aside — same guard as the flights,
+    # done by rename because exclusion-by-path cannot split an appended
+    # file.
+    for stale in sorted(Path(out_dir).glob("telemetry_rank*.jsonl")):
+        stale.rename(stale.with_name(
+            stale.name + f".prev-{int(time.time())}"))
     report = orch.run()
 
     flight_stats = check_fleet_flights(out_dir, report.launches,
                                        ignore=pre_existing_flights)
+
+    # The merged fleet view (ISSUE 14): ONE fleet summary + ONE stitched
+    # Perfetto trace covering every generation and rank — successive
+    # children APPENDED to the shared per-rank stream, so the aggregator
+    # splits at meta headers and the trace gets one stable pid per
+    # (gen, rank). The straggler table inside the summary is the
+    # acceptance probe for the injected loader_stall.
+    stream_paths = sorted(Path(out_dir).glob("telemetry_rank*.jsonl"))
+    fleet_summary = None
+    summary_path = trace_path = None
+    if stream_paths:
+        unreadable: List[str] = []
+        segments = split_streams(stream_paths, missing=unreadable)
+        fleet_summary = aggregate_segments(segments, missing=unreadable)
+        summary_path = base / "fleet_summary.json"
+        summary_path.write_text(
+            json.dumps(fleet_summary, sort_keys=True))
+        trace_path = base / "fleet_trace.json"
+        trace_path.write_text(json.dumps(stitch_perfetto(segments)))
+
+    # a scheduled loader_stall must come back ATTRIBUTED: the stalled
+    # child's generation, the data_wait phase — "one rank is slow and
+    # here is why" is the observability this plane exists to give
+    launched_gens = {launch["generation"] for launch in report.launches}
+    stall_gens = sorted(g for g, c in gen_chaos.items()
+                        if "loader_stall" in c and g in launched_gens)
+    straggler_attributed = None
+    if stall_gens:
+        hits = [s for s in (fleet_summary or {}).get("stragglers", [])
+                if s["phase"] == "data_wait" and s["gen"] in stall_gens]
+        straggler_attributed = bool(hits)
+        if not straggler_attributed:
+            report.errors.append(
+                f"loader_stall chaos on generation(s) {stall_gens} was "
+                "not rank/phase-attributed by the fleet straggler "
+                "detector (expected a data_wait straggler row)")
+
+    metrics_smoke = None
+    if getattr(args, "metrics_port", None):
+        metrics_smoke = any(launch.get("metrics_ok")
+                            for launch in report.launches)
+        if not metrics_smoke:
+            report.errors.append(
+                "--metrics-port was set but no child's /metrics endpoint "
+                "ever answered a scrape with the step counter")
 
     parity = None
     if (report.completed and not args.no_verify_parity
@@ -536,27 +708,48 @@ def fleet_main(args) -> int:
              "worlds": [launch["world"] for launch in report.launches],
              "gen_chaos": {str(k): v for k, v in gen_chaos.items()},
              "parity_bitwise": parity,
+             "fleet_summary": fleet_summary,
+             "fleet_summary_path": (str(summary_path)
+                                    if summary_path else None),
+             "fleet_trace_path": str(trace_path) if trace_path else None,
+             "stragglers": (fleet_summary or {}).get("stragglers", []),
+             "straggler_attributed": straggler_attributed,
+             "metrics_smoke": metrics_smoke,
              **flight_stats, **report.as_dict()}
     ok = (report.completed and parity is not False
           and flight_stats["flights_ok"]
           and report.mismatch_escapes == 0
           and not (gen_chaos and report.relaunches == 0)
+          and straggler_attributed is not False
+          and metrics_smoke is not False
           and (args.no_verify_parity or report.relaunches == 0
                or parity is True))
     if args.as_json:
         print(json.dumps(stats, sort_keys=True))
     else:
         for launch in report.launches:
+            live = (f", live step {launch['live_last_step'] + 1}"
+                    if launch.get("live_last_step", -1) >= 0 else "")
             print(f"generation {launch['generation']}: world "
                   f"{launch['world']} rc={launch['rc']} "
                   f"{launch['outcome']} (step {launch['step_after']}/"
-                  f"{target_step}, {launch['seconds']:.1f}s)")
+                  f"{target_step}, {launch['seconds']:.1f}s{live})")
         print(f"final step: {report.final_step}/{target_step} at world "
               f"{report.final_world}")
         print(f"flights: {len(flight_stats['flights'])} "
               f"(ok={flight_stats['flights_ok']})")
         for problem in flight_stats["flight_problems"]:
             print(f"flight problem: {problem}")
+        if fleet_summary is not None:
+            print(f"fleet summary: {summary_path} "
+                  f"({fleet_summary['n_streams']} stream segment(s)); "
+                  f"merged trace: {trace_path}")
+            for s in fleet_summary["stragglers"]:
+                print(f"straggler: gen={s['gen']} rank={s['rank']} "
+                      f"step={s['step']} {s['phase']} {s['dur_s']:.3f}s "
+                      f"({s['factor']}x {s['basis']})")
+        if metrics_smoke is not None:
+            print(f"metrics_smoke: {metrics_smoke}")
         print(f"parity_bitwise: {parity}")
         for err in report.errors:
             print(f"error: {err}", file=sys.stderr)

@@ -308,6 +308,8 @@ class Supervisor:
         self._factories[plan.world] = plan.state_factory
         _telemetry.counter("elastic_resizes", 1, from_world=old_world,
                            to_world=plan.world, survivors=survivors)
+        # the /metrics world-size gauge tracks every resize live
+        _telemetry.gauge("world_size", plan.world)
         log_main(f"supervisor: elastic resize — mesh re-planned "
                  f"{old_world} -> {plan.world} replicas "
                  f"({survivors} survivor(s)); restoring and resharding")
@@ -386,6 +388,7 @@ class Supervisor:
         self._factories[plan.world] = plan.state_factory
         _telemetry.counter("elastic_resizes", 1, from_world=old_world,
                            to_world=plan.world, direction="grow")
+        _telemetry.gauge("world_size", plan.world)
         report.resizes.append({
             "from_world": old_world, "to_world": plan.world,
             "survivors": avail, "label": self._last_saved_label,

@@ -106,6 +106,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--output-dir", default="./serving_out",
                    help="telemetry stream + flight directory")
     p.add_argument("--no-telemetry", action="store_true")
+    p.add_argument("--metrics-port", default=None, type=int,
+                   help="serve live /metrics + /healthz on this port "
+                        "(+rank offset); default DPT_METRICS_PORT env, "
+                        "else off (zero threads)")
     p.add_argument("--json", action="store_true", dest="as_json")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
@@ -124,13 +128,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..resilience.heartbeat import Deathwatch
     from ..utils.logging import log_main
 
-    if not args.no_telemetry and jax.process_index() == 0:
+    tele_rank = telemetry.rank_identity(jax.process_index())
+    if not args.no_telemetry and telemetry.should_stream(tele_rank):
         Path(args.output_dir).mkdir(parents=True, exist_ok=True)
         telemetry.configure(
-            str(Path(args.output_dir) / "telemetry_rank0.jsonl"),
+            str(Path(args.output_dir)
+                / telemetry.stream_filename(tele_rank)),
+            rank=tele_rank, gen=telemetry.generation_identity(),
             meta={"entry": "serving", "model": args.model,
                   "serve_dtype": args.serve_dtype,
                   "buckets": list(buckets)})
+    # live /metrics + /healthz (telemetry/metrics_http.py): the serving
+    # replica's scrape surface — prefill/decode histograms feed the same
+    # phase metric the training loop's dispatch does, and the healthz
+    # fence counts decode progress. Off (default) starts zero threads.
+    metrics_port = telemetry.resolve_metrics_port(args.metrics_port,
+                                                  tele_rank)
+    if metrics_port and telemetry.is_configured():
+        # None on a bind failure (stderr-noted): the live surface never
+        # takes the serving process down
+        if telemetry.start_metrics_server(metrics_port,
+                                          telemetry.get()) is not None:
+            log_main(f"serving: /metrics + /healthz on :{metrics_port}")
     Deathwatch.arm(log=log_main)
 
     try:
@@ -145,6 +164,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rc=e.code if isinstance(e, SystemExit) else 1)
         raise
     finally:
+        # guarded on the module having loaded: the metrics-off path never
+        # imports metrics_http at all (its zero-cost-when-off contract)
+        if "distributed_pytorch_training_tpu.telemetry.metrics_http" \
+                in sys.modules:
+            telemetry.stop_metrics_server()
         telemetry.reset()
 
 

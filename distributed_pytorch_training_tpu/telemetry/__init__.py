@@ -18,9 +18,20 @@ instrument feeds ONE stream:
   step-time spikes vs a rolling median, loader-stall detection, each an
   ``anomaly`` event with an optional abort hook (off by default);
 * the ``telemetry`` CLI (:mod:`.__main__`) — ``summary`` (per-phase time
-  split + throughput + wire-byte totals), ``tail``, and
+  split + throughput + wire-byte totals, with crash-truncated partial
+  epochs reported explicitly), ``tail`` (``-f`` follows a live stream
+  through rotation), ``aggregate`` (the fleet summary), and
   ``export --perfetto`` (host spans as Chrome trace-event JSON that loads
-  alongside an XLA trace in Perfetto).
+  alongside an XLA trace in Perfetto; multiple streams stitch into one
+  timeline with a stable pid per (gen, rank));
+* the **fleet plane** (ISSUE 14): per-rank streams
+  (``telemetry_rank<R>.jsonl``, rank 0 by default, every rank under the
+  ``--telemetry-all-ranks`` opt-in; every event stamped with its
+  gen/rank identity), cross-stream aggregation with a straggler
+  detector that rank- AND phase-attributes divergence
+  (:mod:`.aggregate`), and a stdlib-only live ``/metrics`` +
+  ``/healthz`` HTTP surface fed by an observer on the recorder
+  (:mod:`.metrics_http`; zero threads when off).
 
 Design constraints (enforced, not aspirational):
 
@@ -40,18 +51,46 @@ Design constraints (enforced, not aspirational):
 from __future__ import annotations
 
 from .recorder import (  # noqa: F401
+    ALL_RANKS_ENV,
+    FLEET_GENERATION_ENV,
+    FLEET_RANK_ENV,
+    REGISTERED_SPAN_NAMES,
     SCHEMA_VERSION,
     NullSpan,
     Recorder,
+    all_ranks_enabled,
     configure,
     counter,
     emit,
     gauge,
+    generation_identity,
     get,
     is_configured,
+    rank_identity,
     reset,
+    should_stream,
     span,
     span_event,
+    stream_filename,
 )
 from .flight import flush_flight, install_excepthook  # noqa: F401
 from .watchdog import AnomalyAbort, AnomalyWatchdog  # noqa: F401
+
+# The live-surface names resolve lazily (PEP 562): metrics_http's cost
+# contract is that the OFF path never even imports it — the recorder,
+# flight recorder, and every jax-free CLI reader import this package
+# without paying for http.server, and the first actual use (train.py's
+# port wiring, a test) triggers the real import.
+_METRICS_EXPORTS = frozenset({
+    "METRICS_PORT_ENV", "MetricsServer", "resolve_metrics_port",
+    "start_metrics_server", "stop_metrics_server",
+})
+
+
+def __getattr__(name: str):
+    if name in _METRICS_EXPORTS:
+        from . import metrics_http
+
+        return getattr(metrics_http, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -14,13 +14,28 @@ Commands:
       anomaly counts — the "gradient sync share of step" table the
       reference promised, computed from the stream's OWN recorded totals
       (the split is checked against the recorded epoch seconds; the
-      unaccounted remainder is printed, never hidden).
-  tail <stream.jsonl> [-n N]
-      Last N events, one per line.
-  export <stream.jsonl> --perfetto -o trace.json
-      Host spans as Chrome trace-event JSON (``ph:"X"`` complete events,
-      wall-clock microseconds) — loads in Perfetto/chrome://tracing
-      alongside the XLA trace captured by utils/profiling.StepProfiler.
+      unaccounted remainder is printed, never hidden). A crash-truncated
+      stream — per-step spans with no enclosing ``epoch_time_s`` total —
+      reports those steps as an explicit PARTIAL EPOCH block instead of
+      folding them into a misleading split.
+  aggregate <stream.jsonl> [<stream.jsonl> ...] [--json]
+      The FLEET summary (telemetry/aggregate.py): merge N per-rank
+      streams (across ranks AND fleet generations; generations appended
+      into one file split at their meta headers) into per-(gen, rank)
+      phase splits side by side, wire rollups by tier/axis, anomaly
+      rollup, and the cross-rank straggler table (slowest rank, with the
+      phase and step that made it slow).
+  tail <stream.jsonl> [-n N] [-f [--poll-s S] [--follow-timeout S]]
+      Last N events, one per line. With ``-f``, keep polling the file for
+      new events (surviving rotation to a new stream file) — the
+      watch-a-live-run mode that needs no HTTP endpoint.
+  export <stream.jsonl> [<stream.jsonl> ...] --perfetto -o trace.json
+      Host spans as Chrome trace-event JSON — loads in Perfetto/
+      chrome://tracing alongside the XLA trace captured by
+      utils/profiling.StepProfiler. One stream exports on the wall
+      clock; multiple streams STITCH into one timeline with a stable
+      pid per (gen, rank) and gauge counter tracks, skew-normalized to
+      each stream's own meta anchor.
 
 Exit codes: 0 ok, 1 unreadable/empty stream, 2 usage error.
 
@@ -33,11 +48,17 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 from collections import defaultdict
 from pathlib import Path
 from typing import List, Optional, Tuple
 
 from .recorder import ELASTIC_SPAN_NAMES, SERVING_SPAN_NAMES, SPAN_NAMES
+
+# The per-step phases: spans that belong INSIDE an epoch's recorded wall.
+# Trailing instances with no epoch_time_s after them are a crash-truncated
+# partial epoch (the summary's explicit PARTIAL block, not split filler).
+IN_EPOCH_SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync")
 
 
 def read_stream(path: str) -> Tuple[List[dict], int]:
@@ -62,13 +83,38 @@ def read_stream(path: str) -> Tuple[List[dict], int]:
 
 def summarize(events: List[dict]) -> dict:
     """The summary body: span totals, counter sums, gauge last-values,
-    the step-time split, and the self-consistency line."""
+    the step-time split, and the self-consistency line.
+
+    Crash truncation (ISSUE 14 satellite): per-step spans are folded into
+    the split only once their enclosing ``epoch_time_s`` total arrives. A
+    mid-epoch crash (or a new ``meta`` header — an appended relaunch)
+    leaves trailing in-epoch spans with NO such total; they are reported
+    as an explicit ``partial_epoch`` block instead of being mixed into
+    the completed epochs' percentages, where they used to force the
+    adaptive denominator and claim a self-consistent 100% split over an
+    epoch that never finished."""
     spans: dict = defaultdict(lambda: {"total_ms": 0.0, "count": 0,
                                        "max_ms": 0.0})
     counters: dict = defaultdict(float)
     gauges: dict = {}
     anomalies: List[dict] = []
     meta: Optional[dict] = None
+    # in-epoch spans seen since the last epoch_time_s counter: folded into
+    # the accounted split by that counter's arrival, or into the PARTIAL
+    # block by a meta boundary / end of stream
+    pending_ms: dict = defaultdict(float)
+    pending_steps = 0
+    partial_ms: dict = defaultdict(float)
+    partial_steps = 0
+
+    def _fold_pending_into_partial():
+        nonlocal pending_ms, pending_steps, partial_steps
+        for n, v in pending_ms.items():
+            partial_ms[n] += v
+        partial_steps += pending_steps
+        pending_ms = defaultdict(float)
+        pending_steps = 0
+
     for ev in events:
         kind = ev.get("kind")
         name = ev.get("name", "?")
@@ -78,14 +124,28 @@ def summarize(events: List[dict]) -> dict:
             s["total_ms"] += dur
             s["count"] += 1
             s["max_ms"] = max(s["max_ms"], dur)
+            if name in IN_EPOCH_SPAN_NAMES:
+                pending_ms[name] += dur
+                if name == "step_dispatch":
+                    pending_steps += 1
         elif kind == "counter":
             counters[name] += float(ev.get("value", 0.0))
+            if name == "epoch_time_s":
+                # the enclosing total arrived: the pending spans belong to
+                # a COMPLETED epoch
+                pending_ms = defaultdict(float)
+                pending_steps = 0
         elif kind == "gauge":
             gauges[name] = ev.get("value")
         elif kind == "anomaly":
             anomalies.append(ev)
-        elif kind == "meta" and meta is None:
-            meta = ev
+        elif kind == "meta":
+            # a relaunch appended to the same stream: whatever the
+            # previous run left pending was truncated, not completed
+            _fold_pending_into_partial()
+            if meta is None:
+                meta = ev
+    _fold_pending_into_partial()
 
     # the step-time split over the canonical phases, against the stream's
     # own recorded wall total (the `epoch_time_s` counter the train loop
@@ -94,10 +154,13 @@ def summarize(events: List[dict]) -> dict:
     # phases legitimately sit OUTSIDE the epoch wall (eval, epoch-boundary
     # save stalls), so when accounted spans exceed it the denominator is
     # the accounted total instead — percentages always close to 100.
+    # Partial-epoch span time is EXCLUDED here (reported in its own
+    # block); the spans table above still shows every span.
     wall_ms = counters.get("epoch_time_s", 0.0) * 1e3
-    accounted = {n: spans[n]["total_ms"]
+    accounted = {n: spans[n]["total_ms"] - partial_ms.get(n, 0.0)
                  for n in SPAN_NAMES + SERVING_SPAN_NAMES
                  + ELASTIC_SPAN_NAMES if n in spans}
+    accounted = {n: v for n, v in accounted.items() if v > 0.0}
     accounted_ms = sum(accounted.values())
     split = {}
     base = max(wall_ms, accounted_ms)
@@ -107,6 +170,16 @@ def summarize(events: List[dict]) -> dict:
         if wall_ms > accounted_ms:
             split["unaccounted"] = round(
                 100.0 * (wall_ms - accounted_ms) / base, 2)
+
+    partial_total = sum(partial_ms.values())
+    partial_epoch = None
+    if partial_steps or partial_total > 0.0:
+        partial_epoch = {
+            "steps": partial_steps,
+            "span_ms": {n: round(v, 3)
+                        for n, v in sorted(partial_ms.items())},
+            "total_ms": round(partial_total, 3),
+        }
 
     out = {
         "schema": (meta or {}).get("schema"),
@@ -125,6 +198,7 @@ def summarize(events: List[dict]) -> dict:
                           if k not in ("v", "ts", "kind", "name")}}
                       for a in anomalies],
         "step_split_pct": split,
+        "partial_epoch": partial_epoch,
         "totals": {
             "recorded_wall_ms": round(wall_ms, 3),
             "accounted_span_ms": round(accounted_ms, 3),
@@ -193,30 +267,125 @@ def _print_summary(s: dict) -> None:
     if "wire" in s:
         for k, v in s["wire"].items():
             print(f"wire: {k} = {v}")
+    if s.get("partial_epoch"):
+        pe = s["partial_epoch"]
+        phases = ", ".join(f"{n} {v:.1f}ms"
+                           for n, v in pe["span_ms"].items())
+        print(f"PARTIAL EPOCH (crash-truncated — no enclosing epoch "
+              f"total): {pe['steps']} step(s), {pe['total_ms']:.1f} ms "
+              f"({phases}) excluded from the split above")
     if s["anomalies"]:
         print(f"ANOMALIES ({len(s['anomalies'])}):")
         for a in s["anomalies"]:
             print(f"  {a}")
 
 
+def _follow(stream: str, n: int, poll_s: float,
+            timeout_s: Optional[float]) -> int:
+    """``tail -f``: print the last N events, then poll the file for new
+    ones — surviving rotation to a new stream file (the follower resets
+    on inode change/truncation). Ctrl-C (or ``--follow-timeout``, the
+    scriptable bound) ends the watch cleanly."""
+    from .aggregate import StreamFollower
+
+    follower = StreamFollower(stream)
+    backlog = follower.poll()
+    for ev in backlog[-n:]:
+        print(json.dumps(ev, sort_keys=True))
+    sys.stdout.flush()
+    deadline = (time.monotonic() + timeout_s
+                if timeout_s is not None else None)
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            for ev in follower.poll():
+                print(json.dumps(ev, sort_keys=True))
+            sys.stdout.flush()
+            time.sleep(poll_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="telemetry", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("command", choices=["summary", "tail", "export"])
-    p.add_argument("stream", help="path to a telemetry JSONL stream")
+    p.add_argument("command",
+                   choices=["summary", "aggregate", "tail", "export"])
+    p.add_argument("streams", nargs="+",
+                   help="telemetry JSONL stream path(s) — aggregate/"
+                        "export merge several; summary/tail take one")
     p.add_argument("--json", action="store_true", dest="as_json")
     p.add_argument("-n", type=int, default=20, help="tail: last N events")
+    p.add_argument("-f", "--follow", action="store_true",
+                   help="tail: keep polling for new events (survives "
+                        "stream rotation)")
+    p.add_argument("--poll-s", type=float, default=0.5,
+                   help="tail -f: poll interval seconds")
+    p.add_argument("--follow-timeout", type=float, default=None,
+                   help="tail -f: stop after this many seconds "
+                        "(default: until Ctrl-C)")
     p.add_argument("--perfetto", action="store_true",
                    help="export: Chrome trace-event JSON")
     p.add_argument("-o", "--output", default=None,
-                   help="export: output path (default: stdout)")
+                   help="export/aggregate: output path (default: stdout)")
     args = p.parse_args(argv)
 
-    if not Path(args.stream).is_file():
-        print(f"telemetry: no such stream: {args.stream}", file=sys.stderr)
+    if args.command == "aggregate":
+        from .aggregate import aggregate_streams, print_fleet_summary
+
+        agg = aggregate_streams(args.streams)
+        if agg["n_streams"] == 0:
+            print("telemetry: no readable stream among "
+                  f"{args.streams}", file=sys.stderr)
+            return 1
+        if args.output:
+            # -o always writes the machine-readable body, whatever the
+            # stdout format — a silently-ignored output path would strand
+            # every script that reads it
+            Path(args.output).write_text(json.dumps(agg, sort_keys=True))
+            print(f"telemetry: wrote {args.output}", file=sys.stderr)
+        if args.as_json:
+            if not args.output:
+                print(json.dumps(agg, sort_keys=True))
+        else:
+            print_fleet_summary(agg)
+        return 0
+
+    if args.command in ("summary", "tail") and len(args.streams) != 1:
+        print(f"telemetry: {args.command} takes exactly one stream "
+              "(aggregate merges several)", file=sys.stderr)
+        return 2
+    stream = args.streams[0]
+
+    if args.command == "tail" and args.follow:
+        # the follower tolerates a not-yet-created stream; no upfront check
+        return _follow(stream, args.n, args.poll_s, args.follow_timeout)
+
+    if args.command == "export" and len(args.streams) > 1:
+        if not args.perfetto:
+            print("telemetry: export needs --perfetto (the only format "
+                  "so far)", file=sys.stderr)
+            return 2
+        from .aggregate import split_streams, stitch_perfetto
+
+        segments = split_streams(args.streams)
+        if not segments:
+            print("telemetry: no readable stream among "
+                  f"{args.streams}", file=sys.stderr)
+            return 1
+        body = json.dumps(stitch_perfetto(segments))
+        if args.output:
+            Path(args.output).write_text(body)
+            print(f"telemetry: wrote {args.output}", file=sys.stderr)
+        else:
+            print(body)
+        return 0
+
+    if not Path(stream).is_file():
+        print(f"telemetry: no such stream: {stream}", file=sys.stderr)
         return 1
-    events, bad = read_stream(args.stream)
+    events, bad = read_stream(stream)
     if bad:
         print(f"telemetry: note: {bad} malformed line(s) skipped",
               file=sys.stderr)

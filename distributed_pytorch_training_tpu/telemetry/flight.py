@@ -44,9 +44,11 @@ _SEQ = itertools.count()
 # generation and rank. A postmortem that cannot say WHICH launch of a
 # relaunch sequence died is half a postmortem — the context rides in the
 # flight's cause (and as structured fields), read straight from the env
-# so no plumbing crosses the library.
-FLEET_GENERATION_ENV = "DPT_FLEET_GENERATION"
-FLEET_RANK_ENV = "DPT_FLEET_RANK"
+# so no plumbing crosses the library. The names moved to recorder.py
+# (ISSUE 14: the recorder stamps the same identity on every stream
+# event); re-exported here for the orchestrator's historical import.
+FLEET_GENERATION_ENV = _recorder.FLEET_GENERATION_ENV
+FLEET_RANK_ENV = _recorder.FLEET_RANK_ENV
 
 
 def _fleet_context() -> dict:

@@ -1,0 +1,366 @@
+"""Live metrics surface (ISSUE 14): a stdlib-only background HTTP thread.
+
+``/metrics`` serves Prometheus text-format gauges/counters/histograms
+aggregated from the SAME event stream the recorder writes — the server
+registers an observer on the :class:`~.recorder.Recorder` and folds each
+event into thread-safe counters as it is emitted, so the scrape handler
+never touches the JSONL and never blocks an emit:
+
+* ``dpt_steps_total`` / ``dpt_last_step`` — the step fence, observed
+  through ``step_dispatch`` spans;
+* ``dpt_epoch`` — the last completed epoch (``epoch_time_s`` counters);
+* ``dpt_phase_seconds`` — one histogram per canonical phase
+  (data_wait / step_dispatch / ... / prefill / decode), fixed buckets;
+* ``dpt_wire_bytes_total{name,tier,axis}`` — the per-tier wire counters
+  (grad_sync's emit_wire_accounting rows; the DCN tier is one more
+  label value, not new code);
+* ``dpt_anomalies_total{name}`` — watchdog detections;
+* ``dpt_gauge{name}`` — every gauge last-value (world_size, capacity,
+  queue depth, EF norm);
+* ``dpt_last_progress_age_seconds`` — seconds since the step fence last
+  ADVANCED (a new high-water `step`, a `steps` counter, or a serving
+  prefill/decode span).
+
+``/healthz`` is the progress-fence liveness probe: 200 while the last
+step advance is younger than ``stale_after_s`` (the server's start time
+seeds the fence, so a compiling run gets its grace), 503 once the fence
+stops advancing — a wedged dispatch, a dead loader, a hung collective
+all flip it without any in-band cooperation from the training loop.
+
+Costs, by construction: OFF means this module is never imported by the
+hot path and zero threads exist (train.py/serving gate on a nonzero
+port). ON means one listener thread + per-event dict updates on the
+host side only — nothing here can touch traced code, so the telemetry
+on/off HLO-identity pin extends to the live surface unchanged.
+
+jax-free and stdlib-only, like every module in this package.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .recorder import (
+    ELASTIC_SPAN_NAMES,
+    Recorder,
+    SERVING_SPAN_NAMES,
+    SPAN_NAMES,
+)
+
+METRICS_PORT_ENV = "DPT_METRICS_PORT"
+METRICS_STALE_S_ENV = "DPT_METRICS_STALE_S"
+
+_PHASES = SPAN_NAMES + SERVING_SPAN_NAMES + ELASTIC_SPAN_NAMES
+
+# seconds; the +Inf bucket is implicit. Spans range from ~100us CPU-mesh
+# dispatches to multi-second compiles/stalls.
+_BUCKETS_S = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+              1.0, 2.5, 5.0, 10.0, 30.0)
+
+# step_dispatch feeds the fence only when its `step` ADVANCES (or is
+# unstamped); the serving phases always count — see _MetricsState.observe.
+_PROGRESS_SPANS = ("step_dispatch", "prefill", "decode")
+
+
+def resolve_metrics_port(cli_port: Optional[int], rank: int = 0) -> int:
+    """The effective port: an explicit CLI value wins, else the
+    ``DPT_METRICS_PORT`` env (the fleet orchestrator's stamp), else off.
+    A nonzero base is offset by the rank so co-hosted ranks under
+    ``--telemetry-all-ranks`` each get their own listener. 0 = off."""
+    base = cli_port
+    if base is None:
+        try:
+            base = int(os.environ.get(METRICS_PORT_ENV, "0"))
+        except ValueError:
+            base = 0
+    base = int(base)
+    return base + int(rank) if base > 0 else 0
+
+
+def _escape_label(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+class _MetricsState:
+    """The scrape-side aggregate, fed one event at a time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.events_total = 0
+        self.steps_total = 0
+        self.last_step = -1
+        self.epoch = -1
+        self.last_progress = self._t0
+        # phase -> (bucket counts, sum_s, count)
+        self.phases: Dict[str, Tuple[List[int], float, int]] = {}
+        self.wire: Dict[Tuple[str, str, str], float] = {}
+        self.anomalies: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+
+    # -- the observer ---------------------------------------------------
+
+    def observe(self, ev: dict) -> None:
+        kind = ev.get("kind")
+        name = ev.get("name", "?")
+        with self._lock:
+            self.events_total += 1
+            if kind == "span":
+                dur_s = float(ev.get("dur_ms", 0.0)) / 1e3
+                if name in _PHASES:
+                    buckets, total, count = self.phases.get(
+                        name, ([0] * (len(_BUCKETS_S) + 1), 0.0, 0))
+                    for i, le in enumerate(_BUCKETS_S):
+                        if dur_s <= le:
+                            buckets[i] += 1
+                            break
+                    else:
+                        buckets[-1] += 1
+                    self.phases[name] = (buckets, total + dur_s, count + 1)
+                if name == "step_dispatch":
+                    self.steps_total += 1
+                    step = ev.get("step")
+                    if step is None:
+                        # an unstamped dispatch carries no fence to
+                        # compare — count it as progress
+                        self.last_progress = time.monotonic()
+                    elif isinstance(step, (int, float)) \
+                            and step > self.last_step:
+                        self.last_step = int(step)
+                        self.last_progress = time.monotonic()
+                    # a re-dispatch of an already-seen step (a restart
+                    # loop replaying from a checkpoint) is NOT progress:
+                    # the fence must ADVANCE to keep /healthz green
+                elif name in ("prefill", "decode"):
+                    # serving progress: every served phase counts
+                    self.last_progress = time.monotonic()
+            elif kind == "counter":
+                if name == "epoch_time_s":
+                    epoch = ev.get("epoch")
+                    if isinstance(epoch, (int, float)):
+                        self.epoch = max(self.epoch, int(epoch))
+                elif name == "steps":
+                    self.last_progress = time.monotonic()
+                if "tier" in ev or "axis" in ev:
+                    key = (name, str(ev.get("tier", "")),
+                           str(ev.get("axis", "")))
+                    self.wire[key] = (self.wire.get(key, 0.0)
+                                      + float(ev.get("value", 0.0)))
+            elif kind == "anomaly":
+                self.anomalies[name] = self.anomalies.get(name, 0) + 1
+            elif kind == "gauge":
+                try:
+                    self.gauges[name] = float(ev.get("value", 0.0))
+                except (TypeError, ValueError):
+                    pass
+
+    # -- the scrape views -----------------------------------------------
+
+    def render(self) -> str:
+        with self._lock:
+            age = time.monotonic() - self.last_progress
+            lines = [
+                "# TYPE dpt_events_total counter",
+                f"dpt_events_total {self.events_total}",
+                "# TYPE dpt_steps_total counter",
+                f"dpt_steps_total {self.steps_total}",
+                "# TYPE dpt_last_step gauge",
+                f"dpt_last_step {self.last_step}",
+                "# TYPE dpt_epoch gauge",
+                f"dpt_epoch {self.epoch}",
+                "# TYPE dpt_last_progress_age_seconds gauge",
+                f"dpt_last_progress_age_seconds {age:.3f}",
+            ]
+            if self.phases:
+                lines.append("# TYPE dpt_phase_seconds histogram")
+                for phase in sorted(self.phases):
+                    buckets, total, count = self.phases[phase]
+                    cum = 0
+                    label = _escape_label(phase)
+                    for le, n in zip(_BUCKETS_S, buckets):
+                        cum += n
+                        lines.append(
+                            f'dpt_phase_seconds_bucket{{phase="{label}",'
+                            f'le="{le:g}"}} {cum}')
+                    cum += buckets[-1]
+                    lines.append(
+                        f'dpt_phase_seconds_bucket{{phase="{label}",'
+                        f'le="+Inf"}} {cum}')
+                    lines.append(f'dpt_phase_seconds_sum{{phase="{label}"}}'
+                                 f' {total:.6f}')
+                    lines.append(f'dpt_phase_seconds_count{{phase='
+                                 f'"{label}"}} {count}')
+            if self.wire:
+                lines.append("# TYPE dpt_wire_bytes_total counter")
+                for (name, tier, axis), v in sorted(self.wire.items()):
+                    lines.append(
+                        f'dpt_wire_bytes_total{{name="{_escape_label(name)}'
+                        f'",tier="{_escape_label(tier)}",axis='
+                        f'"{_escape_label(axis)}"}} {v:g}')
+            if self.anomalies:
+                lines.append("# TYPE dpt_anomalies_total counter")
+                for name, n in sorted(self.anomalies.items()):
+                    lines.append(f'dpt_anomalies_total{{name='
+                                 f'"{_escape_label(name)}"}} {n}')
+            if self.gauges:
+                lines.append("# TYPE dpt_gauge gauge")
+                for name, v in sorted(self.gauges.items()):
+                    lines.append(
+                        f'dpt_gauge{{name="{_escape_label(name)}"}} {v:g}')
+            return "\n".join(lines) + "\n"
+
+    def health(self, stale_after_s: float) -> Tuple[bool, dict]:
+        with self._lock:
+            age = time.monotonic() - self.last_progress
+            healthy = age < stale_after_s
+            return healthy, {
+                "healthy": healthy,
+                "last_progress_age_s": round(age, 3),
+                "stale_after_s": stale_after_s,
+                "last_step": self.last_step,
+                "steps_total": self.steps_total,
+            }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler's contract
+        server: "_Server" = self.server  # type: ignore[assignment]
+        if self.path.split("?")[0] == "/metrics":
+            body = server.state.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path.split("?")[0] == "/healthz":
+            healthy, detail = server.state.health(server.stale_after_s)
+            body = (json.dumps(detail, sort_keys=True) + "\n") \
+                .encode("utf-8")
+            self.send_response(200 if healthy else 503)
+            self.send_header("Content-Type", "application/json")
+        else:
+            body = b"telemetry metrics: /metrics or /healthz\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes must not spam stdout
+        return
+
+
+class _Server(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, state: _MetricsState, stale_after_s: float):
+        super().__init__(addr, _Handler)
+        self.state = state
+        self.stale_after_s = stale_after_s
+
+
+class MetricsServer:
+    """The background `/metrics` + `/healthz` listener.
+
+    ``port=0`` binds an ephemeral port (tests); :meth:`start` returns the
+    bound port. ``recorder`` is the stream to observe (its observer is
+    removed again on :meth:`stop`). ``stale_after_s`` is the healthz
+    fence: default from ``DPT_METRICS_STALE_S``, else 300s — generous
+    because a first-step compile is legitimate silence."""
+
+    def __init__(self, port: int, recorder: Optional[Recorder] = None,
+                 host: str = "0.0.0.0",
+                 stale_after_s: Optional[float] = None):
+        if stale_after_s is None:
+            try:
+                stale_after_s = float(
+                    os.environ.get(METRICS_STALE_S_ENV, "300"))
+            except ValueError:
+                stale_after_s = 300.0
+        self.state = _MetricsState()
+        self._host = host
+        self._want_port = int(port)
+        self._recorder = recorder
+        self.stale_after_s = float(stale_after_s)
+        self._httpd: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return (self._httpd.server_address[1]
+                if self._httpd is not None else None)
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port  # type: ignore[return-value]
+        self._httpd = _Server((self._host, self._want_port), self.state,
+                              self.stale_after_s)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            name=f"dpt-metrics-{self.port}", daemon=True)
+        self._thread.start()
+        if self._recorder is not None:
+            self._recorder.add_observer(self.state.observe)
+        return self.port  # type: ignore[return-value]
+
+    def stop(self) -> None:
+        if self._recorder is not None:
+            self._recorder.remove_observer(self.state.observe)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# module-global lifecycle (the train.py / serving wiring): one server per
+# process, started only when a port resolves nonzero — off means this
+# function is the only thing that ran, and it started nothing.
+# ---------------------------------------------------------------------------
+
+_SERVER: Optional[MetricsServer] = None
+
+
+def start_metrics_server(port: int, recorder: Optional[Recorder] = None,
+                         **kwargs: Any) -> Optional[MetricsServer]:
+    """Start (or replace) the process-global metrics server. ``port <= 0``
+    is a no-op returning None — the off path creates zero threads. A bind
+    failure (the port is taken) also returns None, with a stderr note:
+    the live surface shares the recorder's contract — a broken
+    observability convenience must never take the training run down."""
+    import sys
+
+    global _SERVER
+    if port <= 0:
+        return None
+    stop_metrics_server()
+    server = MetricsServer(port, recorder=recorder, **kwargs)
+    try:
+        server.start()
+    except OSError as e:
+        print(f"telemetry: /metrics server could not bind port {port} "
+              f"({e}) — continuing without the live surface",
+              file=sys.stderr, flush=True)
+        return None
+    _SERVER = server
+    return _SERVER
+
+
+def stop_metrics_server() -> None:
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.stop()
+        _SERVER = None
+
+
+def get_metrics_server() -> Optional[MetricsServer]:
+    return _SERVER

@@ -29,9 +29,19 @@ OS-buffered lines, and the flight recorder's explicitly-fsync'd
 This module imports neither jax nor anything from the package that does:
 arming telemetry must never initialize a backend (the heartbeat
 constraint), and the CLI must read streams on machines with no accelerator
-stack at all. Process-0 gating is therefore the CALLER's job (train.py
-configures the recorder only on process 0 — the file is named
-``telemetry_rank0.jsonl`` for exactly that reason).
+stack at all. Process-0 gating is therefore the CALLER's job — train.py
+gates on :func:`should_stream` (rank 0 always; other ranks only under the
+``--telemetry-all-ranks`` / ``DPT_TELEMETRY_ALL_RANKS`` opt-in, so the
+default run's disk cost is one stream) and names the file
+:func:`stream_filename` (``telemetry_rank<R>.jsonl``).
+
+Rank identity (ISSUE 14): a recorder knows WHICH stream it is. The fleet
+orchestrator (resilience/fleet.py) stamps ``DPT_FLEET_GENERATION`` /
+``DPT_FLEET_RANK`` into every child's env; outside a fleet the caller
+passes the jax process index as the fallback (this module stays jax-free,
+so it can only receive it). Every event carries ``gen``/``rank`` fields —
+that is the v2 schema change — so N streams merge attributably
+(telemetry/aggregate.py) even when generations share one appended file.
 """
 
 from __future__ import annotations
@@ -42,9 +52,23 @@ import os
 import threading
 import time
 from pathlib import Path
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Callable, Deque, Dict, List, Optional
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 14): every event (meta included) carries `gen`/`rank`. Readers
+# accept v1 streams — a missing gen/rank reads as 0/0 (the aggregator's
+# normalization), and `summarize` never keyed on the version.
+SCHEMA_VERSION = 2
+
+# The fleet-context env names (the orchestrator is the writer, this module
+# and the flight recorder are the readers — one definition, re-exported by
+# telemetry/flight.py for the orchestrator's import).
+FLEET_GENERATION_ENV = "DPT_FLEET_GENERATION"
+FLEET_RANK_ENV = "DPT_FLEET_RANK"
+
+# Non-zero-rank streaming opt-in: rank 0 always streams; other ranks only
+# when this env (or the --telemetry-all-ranks flag feeding it) says so —
+# the default run writes exactly one telemetry_rank0.jsonl, unchanged.
+ALL_RANKS_ENV = "DPT_TELEMETRY_ALL_RANKS"
 
 # Canonical span names `telemetry summary` buckets into the step-time
 # split. Free-form names are legal; these are the contract.
@@ -72,6 +96,74 @@ SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain")
 ELASTIC_SPAN_NAMES = ("elastic_replan", "elastic_reshard", "elastic_grow",
                       "capacity_watch")
 
+# Registered-but-unaccounted span names: visible in the spans table, never
+# summed into the step-time split (the `compile` double-count rationale
+# above). Together the four tuples are THE span-name registry — the
+# `span-names-registered` AST rule (analysis/ast_rules.py) flags any
+# in-repo emission whose literal name is not in it, because `telemetry
+# summary` silently buckets unknown names into "unaccounted": a typo'd
+# span name would vanish from the split instead of failing loudly.
+AUX_SPAN_NAMES = ("compile",)
+
+REGISTERED_SPAN_NAMES = (SPAN_NAMES + SERVING_SPAN_NAMES
+                         + ELASTIC_SPAN_NAMES + AUX_SPAN_NAMES)
+
+
+# ---------------------------------------------------------------------------
+# Rank identity (ISSUE 14): which stream is this process?
+# ---------------------------------------------------------------------------
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def generation_identity() -> int:
+    """The fleet launch generation (``DPT_FLEET_GENERATION``), 0 outside a
+    fleet — gen 0 IS the un-orchestrated run's identity, not a sentinel."""
+    return _env_int(FLEET_GENERATION_ENV, 0)
+
+
+def rank_identity(process_index: Optional[int] = None) -> int:
+    """The stream rank: the fleet env stamp wins (``DPT_FLEET_RANK``),
+    else the caller-provided jax process index (this module cannot import
+    jax to ask), else 0."""
+    env_rank = os.environ.get(FLEET_RANK_ENV)
+    if env_rank is not None:
+        try:
+            return int(env_rank)
+        except ValueError:
+            pass
+    return int(process_index) if process_index is not None else 0
+
+
+def stream_filename(rank: int = 0) -> str:
+    """``telemetry_rank<R>.jsonl`` — rank 0 keeps the historical name, so
+    every existing reader/doc/test path stays valid."""
+    return f"telemetry_rank{int(rank)}.jsonl"
+
+
+def all_ranks_enabled(flag: bool = False) -> bool:
+    """The non-zero-rank streaming opt-in: an explicit CLI flag OR a
+    truthy ``DPT_TELEMETRY_ALL_RANKS`` (the fleet orchestrator's way to
+    arm children it cannot pass flags to)."""
+    if flag:
+        return True
+    raw = os.environ.get(ALL_RANKS_ENV, "").strip().lower()
+    return raw not in ("", "0", "false", "no", "off")
+
+
+def should_stream(rank: int, all_ranks: bool = False) -> bool:
+    """Rank 0 always streams; other ranks only under the opt-in — the
+    default run's disk cost (one JSONL) is unchanged by construction."""
+    return rank == 0 or all_ranks_enabled(all_ranks)
+
 
 class Recorder:
     """Append-only JSONL + bounded ring buffer of typed events.
@@ -84,14 +176,25 @@ class Recorder:
 
     def __init__(self, path: Optional[str] = None, ring_size: int = 512,
                  fsync_every_s: float = 2.0, run_id: Optional[str] = None,
-                 meta: Optional[Dict[str, Any]] = None):
+                 meta: Optional[Dict[str, Any]] = None,
+                 gen: Optional[int] = None, rank: Optional[int] = None):
         self.path = Path(path) if path is not None else None
         self.ring: Deque[dict] = collections.deque(maxlen=max(1, ring_size))
         self.run_id = run_id or f"run-{os.getpid()}-{int(time.time())}"
+        # stream identity (v2): env stamps win, explicit args override —
+        # stamped on EVERY event so merged/append-shared files stay
+        # attributable line by line
+        self.gen = int(gen) if gen is not None else generation_identity()
+        self.rank = int(rank) if rank is not None else rank_identity()
         self._fsync_every_s = fsync_every_s
         self._last_fsync = time.monotonic()
         self._lock = threading.Lock()
         self._fh = None
+        # observers (telemetry/metrics_http.py): called with each event
+        # AFTER it is recorded, outside the stream lock (an observer
+        # taking its own lock must never be able to deadlock an emit).
+        # Empty on every run without a live surface — one list check.
+        self._observers: List[Callable[[dict], None]] = []
         self.n_events = 0
         if self.path is not None:
             self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -105,7 +208,7 @@ class Recorder:
     def emit(self, kind: str, name: str, **fields: Any) -> dict:
         """Append one event to the ring (always) and the JSONL (if open)."""
         ev = {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind,
-              "name": name}
+              "name": name, "gen": self.gen, "rank": self.rank}
         ev.update(fields)
         with self._lock:
             self.ring.append(ev)
@@ -123,7 +226,28 @@ class Recorder:
                     # a full/readonly disk (or a handle closed under us)
                     # must never take the training run down with it
                     pass
+            observers = list(self._observers) if self._observers else None
+        if observers:
+            for obs in observers:
+                try:
+                    obs(ev)
+                except Exception:  # noqa: BLE001 — a broken live surface
+                    pass           # must never take the run down with it
         return ev
+
+    # -- observers (the live /metrics surface) ----------------------------
+
+    def add_observer(self, fn: Callable[[dict], None]) -> None:
+        """Register a per-event callback (metrics_http's state feed).
+        Observers run outside the stream lock and MUST NOT emit."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
 
     # -- typed helpers ----------------------------------------------------
 

@@ -1442,7 +1442,7 @@ class Trainer:
                 break
             data_wait_s = time.perf_counter() - t_wait
             telemetry.span_event("data_wait", data_wait_s,
-                                 step=start_step + i)
+                                 step=start_step + i, epoch=epoch)
             if fault_hook is not None:
                 fault_hook(i)
             if step_hook is not None:
@@ -1451,7 +1451,7 @@ class Trainer:
             state, metrics = self._train_step(state, batch, epoch_key)
             dispatch_s = time.perf_counter() - t_disp
             telemetry.span_event("step_dispatch", dispatch_s,
-                                 step=start_step + i)
+                                 step=start_step + i, epoch=epoch)
             if watchdog is not None:
                 watchdog.observe_step(start_step + i,
                                       data_wait_s + dispatch_s,

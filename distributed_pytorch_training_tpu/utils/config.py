@@ -204,6 +204,22 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "process 0 only) and the flight recorder. "
                              "Telemetry is host-side only and never "
                              "changes training numerics (PARITY.md)")
+    parser.add_argument("--telemetry-all-ranks", action="store_true",
+                        help="stream telemetry from EVERY rank "
+                             "(telemetry_rank<R>.jsonl per process) "
+                             "instead of rank 0 only — the fleet "
+                             "aggregation input (`telemetry aggregate`). "
+                             "Also armed by DPT_TELEMETRY_ALL_RANKS=1 "
+                             "(how the fleet orchestrator reaches "
+                             "children). Default off: one stream, "
+                             "unchanged disk cost")
+    parser.add_argument("--metrics-port", default=None, type=int,
+                        help="serve live /metrics (Prometheus text) + "
+                             "/healthz (step-fence liveness) on this "
+                             "port + rank offset "
+                             "(telemetry/metrics_http.py). Default: "
+                             "DPT_METRICS_PORT env, else off — off "
+                             "starts zero threads")
     parser.add_argument("--telemetry-abort", action="store_true",
                         help="turn the anomaly watchdog's abort hook ON: "
                              "a detected non-finite loss / step-time spike "

@@ -49,6 +49,10 @@ _FILE_BUDGETS_S = {
     # cost is the budget driver, and a new parity leg silently pushing
     # the fast suite into the 870 s tier-1 timeout must name itself here.
     "test_tp.py": 300.0,           # measured ~100 s fast
+    # The fleet observability suite (ISSUE 14): synthetic streams + one
+    # real mock-step loop leg + HTTP scrapes with sub-second sleeps —
+    # cheap today, but endpoint tests accrete timeouts easily.
+    "test_telemetry_fleet.py": 90.0,   # measured ~3 s fast
 }
 _file_seconds: dict = {}
 

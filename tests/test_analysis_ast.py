@@ -537,6 +537,98 @@ class TestEngine:
         assert "test_analysis_ast.py" not in files
 
 
+class TestSpanNamesRegistered:
+    """ISSUE 14 satellite: every span name emitted in-repo must appear in
+    the recorder's registry — `telemetry summary` silently buckets
+    unknown names into 'unaccounted', so a typo'd span VANISHES from the
+    split instead of failing loudly."""
+
+    RULE = ["span-names-registered"]
+
+    def test_mutation_unregistered_literal_flags(self, tmp_path):
+        for src in (
+            # module-attribute form, context manager
+            "from .. import telemetry\n"
+            "with telemetry.span('rogue_phase'):\n    pass\n",
+            # span_event hot-loop form
+            "from .. import telemetry\n"
+            "telemetry.span_event('also_rogue', 0.1)\n",
+            # member import
+            "from ..telemetry import span_event\n"
+            "span_event('rogue_member', 0.1, step=3)\n",
+            # ALIASED member import (the pallas rule's alias-aware bar)
+            "from ..telemetry import span_event as se\n"
+            "se('aliased_rogue', 0.1)\n",
+            "from distributed_pytorch_training_tpu.telemetry.recorder "
+            "import span as s\n"
+            "s('aliased_rogue_2')\n",
+            # unaliased dotted import
+            "import distributed_pytorch_training_tpu.telemetry\n"
+            "distributed_pytorch_training_tpu.telemetry"
+            ".span('dotted_rogue')\n",
+        ):
+            findings = _lint(tmp_path, src, rules=self.RULE)
+            assert _rules_of(findings) == set(self.RULE), \
+                f"did not flag: {src!r}"
+
+    def test_mutation_dynamic_name_flags(self, tmp_path):
+        src = ("from .. import telemetry\n"
+               "nm = 'x'\n"
+               "telemetry.span(nm)\n")
+        findings = _lint(tmp_path, src, rules=self.RULE)
+        assert _rules_of(findings) == set(self.RULE)
+        assert "dynamic span name" in findings[0].message
+
+    def test_registered_names_and_other_emits_are_clean(self, tmp_path):
+        src = """
+            from .. import telemetry
+            with telemetry.span("step_dispatch", epoch=0):
+                pass
+            telemetry.span_event("data_wait", 0.1, step=0)
+            telemetry.span_event("prefill", 0.1)
+            with telemetry.span("elastic_grow"):
+                pass
+            with telemetry.span("compile", program="decode"):
+                pass
+            telemetry.counter("any_counter_name", 1)   # counters are free
+            telemetry.gauge("any_gauge_name", 1)
+            MSG = "telemetry.span('prose_mention') in a string is fine"
+        """
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+
+    def test_suppression_and_no_import_are_clean(self, tmp_path):
+        suppressed = (
+            "from .. import telemetry\n"
+            "telemetry.span('rogue')  "
+            "# analysis: disable=span-names-registered\n")
+        assert _lint(tmp_path, suppressed, rules=self.RULE) == []
+        # a local object named `span` with no telemetry import bound
+        unbound = "def span(n):\n    return n\nspan('whatever')\n"
+        assert _lint(tmp_path, unbound, rules=self.RULE) == []
+
+    def test_registry_matches_the_recorder(self):
+        """The rule reads the REAL registry (one definition): every
+        canonical tuple is included."""
+        from distributed_pytorch_training_tpu.analysis.ast_rules import (
+            _registered_span_names,
+        )
+        from distributed_pytorch_training_tpu.telemetry.recorder import (
+            AUX_SPAN_NAMES, ELASTIC_SPAN_NAMES, SERVING_SPAN_NAMES,
+            SPAN_NAMES,
+        )
+
+        reg = _registered_span_names()
+        assert set(SPAN_NAMES) <= reg
+        assert set(SERVING_SPAN_NAMES) <= reg
+        assert set(ELASTIC_SPAN_NAMES) <= reg
+        assert set(AUX_SPAN_NAMES) <= reg
+
+    def test_repo_emits_only_registered_names(self):
+        """The rule binds on the real tree: every span emission in the
+        package + scripts uses a registered name today."""
+        assert run_ast_rules(rules=["span-names-registered"]) == []
+
+
 def test_repo_is_clean_under_every_ast_rule():
     """The tier-1 gate for the source-level contracts: the package and the
     top-level scripts carry zero violations (suppressions included)."""

@@ -41,6 +41,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "elastic-grow-census" in kinds
     assert "tp-psum-signature" in kinds
     assert "fsdp-gather-rides-data-only" in kinds
+    assert "span-names-registered" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

@@ -251,6 +251,77 @@ class TestFleetFlights:
         assert "not a preemption" in stats["flight_problems"][0]
 
 
+class TestWatchAndScrapeWiring:
+    """ISSUE 14: the orchestrator's live watch — child cleanup on an
+    interrupted watch, and the metrics-port stamping contract."""
+
+    def test_exception_in_watch_kills_the_child(self, tmp_path,
+                                                monkeypatch):
+        """subprocess.run's kill-on-exception contract, kept across the
+        Popen switch: a Ctrl-C (or raising callback) mid-watch must not
+        orphan a running training child."""
+        import subprocess as sp
+
+        sleeper = tmp_path / "sleeper.py"
+        sleeper.write_text("import time\ntime.sleep(600)\n")
+        ckpt = tmp_path / "ckpt"
+        orch = FleetOrchestrator(
+            lambda world, generation, resume: [sys.executable,
+                                               str(sleeper)],
+            ckpt, global_batch=16, target_step=12, capacity_for=[8],
+            max_launches=1, log=lambda _m: None)
+        started: list = []
+        real_popen = sp.Popen
+
+        def capture_popen(*args, **kwargs):
+            proc = real_popen(*args, **kwargs)
+            started.append(proc)
+            return proc
+
+        monkeypatch.setattr(sp, "Popen", capture_popen)
+
+        def boom(proc, launch, generation):
+            raise KeyboardInterrupt
+
+        monkeypatch.setattr(orch, "_watch_child", boom)
+        with pytest.raises(KeyboardInterrupt):
+            orch.run()
+        (proc,) = started
+        assert proc.poll() is not None   # killed, not orphaned
+
+    def test_metrics_port_stamp_is_the_base_port(self, tmp_path):
+        """The child applies its own rank offset (resolve_metrics_port
+        reads DPT_FLEET_RANK), so the orchestrator stamps the BASE port
+        — base+rank here would offset twice."""
+        from distributed_pytorch_training_tpu.telemetry.metrics_http import (
+            METRICS_PORT_ENV, resolve_metrics_port,
+        )
+
+        orch, _ = _orchestrator(tmp_path, [{"rc": 0}], [8])
+        orch.metrics_port = 9200
+        env0 = orch._child_env(8, 0, rank=0)
+        env2 = orch._child_env(8, 0, rank=2)
+        assert env0[METRICS_PORT_ENV] == "9200"
+        assert env2[METRICS_PORT_ENV] == "9200"
+        # ... and the child-side resolution lands each rank on its own
+        # port from that one stamped value
+        assert resolve_metrics_port(None, rank=0) == 0  # env unset here
+        import os
+        os.environ[METRICS_PORT_ENV] = env2[METRICS_PORT_ENV]
+        try:
+            assert resolve_metrics_port(None, rank=2) == 9202
+        finally:
+            del os.environ[METRICS_PORT_ENV]
+
+    def test_no_metrics_port_leaves_env_unstamped(self, tmp_path):
+        from distributed_pytorch_training_tpu.telemetry.metrics_http import (
+            METRICS_PORT_ENV,
+        )
+
+        orch, _ = _orchestrator(tmp_path, [{"rc": 0}], [8])
+        assert METRICS_PORT_ENV not in orch._child_env(8, 0)
+
+
 def test_fleet_command_registered():
     """`resilience fleet` parses (the console-script surface) and the
     orchestrator module is importable without jax initialized."""
@@ -274,11 +345,20 @@ def test_fleet_cli_e2e_kill_shrink_grow_bitwise(tmp_path, capsys):
     capacity return, completing with the final checkpoint BITWISE equal
     to an uninterrupted control child continuing from the last handoff.
     One attributable flight per abnormal child exit; zero
-    CheckpointWorldSizeMismatch escapes."""
+    CheckpointWorldSizeMismatch escapes.
+
+    Extended for ISSUE 14: the default schedule also injects a
+    loader_stall into generation 2, and the run must yield ONE merged
+    fleet summary + ONE stitched Perfetto trace covering every
+    generation (exactly one pid per (gen, rank)), with the stall rank-
+    AND phase-attributed in the straggler table; every child serves
+    /metrics (port stamped by the orchestrator) and at least one live
+    scrape must have answered with the step counter."""
     from distributed_pytorch_training_tpu.resilience.__main__ import main
 
     rc = main(["fleet", "--layout", "zero1",
-               "--ckpt-dir", str(tmp_path), "--json"])
+               "--ckpt-dir", str(tmp_path), "--metrics-port", "19377",
+               "--json"])
     stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0
     assert stats["completed"] is True
@@ -296,3 +376,28 @@ def test_fleet_cli_e2e_kill_shrink_grow_bitwise(tmp_path, capsys):
                    "fleet_logs").glob("gen*.log"))
     resumed = [p.read_text(errors="replace") for p in logs[1:]]
     assert all("ELASTIC RESUME" in t for t in resumed)
+
+    # --- the merged fleet view (ISSUE 14 acceptance) ---
+    summary = stats["fleet_summary"]
+    assert summary is not None and summary["n_streams"] == 3
+    assert summary["identities"] == [[0, 0], [1, 0], [2, 0]]  # json lists
+    assert Path(stats["fleet_summary_path"]).is_file()
+    # the injected loader_stall on gen 2 is rank- AND phase-attributed
+    assert stats["straggler_attributed"] is True
+    hits = [s for s in stats["stragglers"]
+            if s["gen"] == 2 and s["phase"] == "data_wait"]
+    assert hits and hits[0]["dur_s"] >= 1.0
+    # ONE stitched trace, exactly one pid/tid pair per (gen, rank)
+    trace = json.loads(Path(stats["fleet_trace_path"]).read_text())
+    names = {e["args"]["name"]: e["pid"]
+             for e in trace["traceEvents"] if e["ph"] == "M"}
+    assert names == {"gen0/rank0": 1, "gen1/rank0": 2, "gen2/rank0": 3}
+    span_keys = {(e["pid"], e["tid"])
+                 for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {pid for pid, _ in span_keys} == {1, 2, 3}
+    assert all(tid == 1 for _, tid in span_keys)
+    # the live /metrics smoke answered during at least one child
+    assert stats["metrics_smoke"] is True
+    assert any(l["metrics_ok"] for l in stats["launches"])
+    # and the tail thread saw live per-generation progress
+    assert any(l["live_last_step"] >= 0 for l in stats["launches"])

@@ -86,6 +86,77 @@ class TestRecorder:
         assert rec.ring[-1]["name"] == "after"  # ring still records
 
 
+class TestRankIdentity:
+    """ISSUE 14: per-rank streams. The fleet env stamps win, the caller's
+    process index is the fallback, every event carries gen/rank, and the
+    DEFAULT gating still writes only telemetry_rank0.jsonl."""
+
+    def test_env_stamps_win_and_events_carry_them(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv(telemetry.FLEET_GENERATION_ENV, "2")
+        monkeypatch.setenv(telemetry.FLEET_RANK_ENV, "3")
+        assert telemetry.rank_identity(process_index=7) == 3  # env wins
+        assert telemetry.generation_identity() == 2
+        rec = telemetry.configure(
+            str(tmp_path / telemetry.stream_filename(3)))
+        rec.counter("c", 1)
+        telemetry.reset()
+        events, _ = read_stream(
+            str(tmp_path / "telemetry_rank3.jsonl"))
+        assert all(e["gen"] == 2 and e["rank"] == 3 for e in events)
+        assert events[0]["schema"] == telemetry.SCHEMA_VERSION == 2
+
+    def test_process_index_fallback_outside_a_fleet(self, monkeypatch):
+        monkeypatch.delenv(telemetry.FLEET_RANK_ENV, raising=False)
+        monkeypatch.delenv(telemetry.FLEET_GENERATION_ENV, raising=False)
+        assert telemetry.rank_identity(process_index=5) == 5
+        assert telemetry.rank_identity() == 0
+        assert telemetry.generation_identity() == 0
+
+    def test_default_gating_is_rank0_only(self, monkeypatch):
+        """The disk-cost contract: without the opt-in, only rank 0
+        streams — a default run still writes ONE telemetry_rank0.jsonl."""
+        monkeypatch.delenv(telemetry.ALL_RANKS_ENV, raising=False)
+        assert telemetry.should_stream(0)
+        assert not telemetry.should_stream(1)
+        assert not telemetry.should_stream(7)
+        # the flag OR the env arms every rank
+        assert telemetry.should_stream(1, all_ranks=True)
+        monkeypatch.setenv(telemetry.ALL_RANKS_ENV, "1")
+        assert telemetry.should_stream(7)
+        monkeypatch.setenv(telemetry.ALL_RANKS_ENV, "0")
+        assert not telemetry.should_stream(7)
+
+    def test_stream_filename_keeps_rank0_name(self):
+        assert telemetry.stream_filename(0) == "telemetry_rank0.jsonl"
+        assert telemetry.stream_filename(4) == "telemetry_rank4.jsonl"
+
+    def test_v1_stream_still_reads(self, tmp_path):
+        """The schema bump's reader contract: a v1 stream (no gen/rank
+        stamps) still summarizes, and the aggregator normalizes it to
+        gen 0 / rank 0."""
+        from distributed_pytorch_training_tpu.telemetry.aggregate import (
+            split_streams,
+        )
+
+        p = tmp_path / "v1.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({"v": 1, "ts": 1.0, "kind": "meta",
+                                "name": "stream", "schema": 1,
+                                "run_id": "old"}) + "\n")
+            f.write(json.dumps({"v": 1, "ts": 1.1, "kind": "span",
+                                "name": "step_dispatch", "t0": 1.0,
+                                "dur_ms": 5.0, "step": 0}) + "\n")
+            f.write(json.dumps({"v": 1, "ts": 1.2, "kind": "counter",
+                                "name": "epoch_time_s",
+                                "value": 0.01}) + "\n")
+        events, bad = read_stream(str(p))
+        assert bad == 0
+        assert summarize(events)["spans"]["step_dispatch"]["count"] == 1
+        (seg,) = split_streams([p])
+        assert seg.key == (0, 0) and seg.run_id == "old"
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder
 # ---------------------------------------------------------------------------
@@ -290,13 +361,16 @@ class TestCli:
         inside the prefill/decode/step_dispatch span that triggered it —
         summing it as its own phase would double-count the wall) but stays
         visible in the spans table."""
+        # real emission order: spans first, the enclosing epoch total
+        # last (a counter BEFORE its spans would read the tail as a
+        # crash-truncated partial epoch — the ISSUE 14 satellite)
         events = [
-            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
             {"kind": "span", "name": "elastic_replan", "dur_ms": 100.0},
             {"kind": "span", "name": "elastic_reshard", "dur_ms": 200.0},
             # 700ms dispatch that INCLUDES a 300ms nested compile
             {"kind": "span", "name": "step_dispatch", "dur_ms": 700.0},
             {"kind": "span", "name": "compile", "dur_ms": 300.0},
+            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
         ]
         s = summarize(events)
         split = s["step_split_pct"]
@@ -313,11 +387,11 @@ class TestCli:
         boundary polls) — are canonical phases in the named split, not
         'unaccounted'."""
         events = [
-            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
             {"kind": "span", "name": "elastic_grow", "dur_ms": 400.0},
             {"kind": "span", "name": "capacity_watch", "dur_ms": 50.0},
             {"kind": "span", "name": "capacity_watch", "dur_ms": 50.0},
             {"kind": "span", "name": "step_dispatch", "dur_ms": 500.0},
+            {"kind": "counter", "name": "epoch_time_s", "value": 1.0},
         ]
         split = summarize(events)["step_split_pct"]
         assert split["elastic_grow"] == 40.0
@@ -453,25 +527,39 @@ class TestInstrumentedLoop:
             320 / epoch_time, rel=1e-3)
 
     def test_hlo_identical_with_telemetry_on_and_off(self, tmp_path,
-                                                     tiny_rig):
+                                                     tiny_rig,
+                                                     monkeypatch):
         """PARITY.md's guarantee, pinned: telemetry adds surfaces and never
         changes training numerics — the lowered step of the SAME config is
         textually identical whether a recorder + watchdog are installed or
-        not (instrumentation is host-side only; the new AST rule keeps
-        emits out of traced bodies)."""
+        not (instrumentation is host-side only; the AST rules keep emits
+        out of traced bodies). Extended for ISSUE 14: the ON side now
+        carries the FULL new surface — a fleet-stamped per-rank recorder
+        (gen/rank on every event), the all-ranks opt-in armed, AND a live
+        /metrics server observing the stream — and the HLO still cannot
+        tell."""
         trainer, state_factory, loader = tiny_rig
         state = state_factory()
         batch = next(iter(loader.epoch(0)))
         key = jax.random.PRNGKey(0)
         assert telemetry.get() is None
         off = trainer._train_step.lower(state, batch, key).as_text()
-        telemetry.configure(str(tmp_path / "t.jsonl"))
+        monkeypatch.setenv(telemetry.ALL_RANKS_ENV, "1")
+        monkeypatch.setenv(telemetry.FLEET_GENERATION_ENV, "3")
+        monkeypatch.setenv(telemetry.FLEET_RANK_ENV, "1")
+        rec = telemetry.configure(
+            str(tmp_path / telemetry.stream_filename(1)))
+        assert (rec.gen, rec.rank) == (3, 1)
+        server = telemetry.MetricsServer(0, recorder=rec)  # ephemeral
+        server.start()
         trainer.watchdog = telemetry.AnomalyWatchdog()
         try:
             on = trainer._train_step.lower(state, batch, key).as_text()
         finally:
             trainer.watchdog = None
+            server.stop()
             telemetry.reset()
+        assert server.port is None  # stopped: the thread is gone
         assert on == off
 
     @pytest.mark.slow
@@ -535,6 +623,90 @@ def test_telemetry_console_script_declared():
     assert ('telemetry = "distributed_pytorch_training_tpu.telemetry.'
             '__main__:main"') in pyproject
     assert callable(telemetry_main)
+
+
+# ---------------------------------------------------------------------------
+# crash-truncated streams (ISSUE 14 satellite): the partial epoch is
+# reported explicitly, never folded into a misleading split
+# ---------------------------------------------------------------------------
+
+
+class TestPartialEpoch:
+    def test_sigkilled_run_reports_partial_epoch(self, tmp_path):
+        """Regression: a SIGKILL mid-epoch-2 leaves per-step spans with no
+        enclosing epoch_time_s. The summary used to fold them into the
+        accounted split (the adaptive denominator then claimed a
+        self-consistent 100% over an epoch that never finished); it must
+        now report them as an explicit PARTIAL block, excluded from the
+        completed epoch's percentages. The child SIGKILLs itself — no
+        atexit, no flush-at-exit — so this also pins the recorder's
+        per-line flush durability."""
+        src = textwrap.dedent(f"""
+            import os, signal, sys
+            sys.path.insert(0, {str(REPO)!r})
+            from distributed_pytorch_training_tpu import telemetry
+            rec = telemetry.configure(
+                {str(tmp_path / 'telemetry_rank0.jsonl')!r})
+            for s in range(20):           # epoch 0 completes
+                rec.span_event("data_wait", 0.001, step=s, epoch=0)
+                rec.span_event("step_dispatch", 0.002, step=s, epoch=0)
+            rec.counter("epoch_time_s", 0.08, epoch=0)
+            rec.counter("steps", 20, epoch=0)
+            for s in range(20, 27):       # epoch 1 truncated at step 7
+                rec.span_event("data_wait", 0.001, step=s, epoch=1)
+                rec.span_event("step_dispatch", 0.002, step=s, epoch=1)
+            os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        r = subprocess.run([sys.executable, "-c", src],
+                           capture_output=True, timeout=120)
+        assert r.returncode == -signal.SIGKILL
+        events, bad = read_stream(str(tmp_path / "telemetry_rank0.jsonl"))
+        assert bad == 0
+        s = summarize(events)
+        # the partial epoch is named: 7 steps, both phases, with their ms
+        assert s["partial_epoch"] is not None
+        assert s["partial_epoch"]["steps"] == 7
+        assert set(s["partial_epoch"]["span_ms"]) == {"data_wait",
+                                                      "step_dispatch"}
+        assert s["partial_epoch"]["total_ms"] == pytest.approx(
+            7 * 3.0, rel=0.01)
+        # the split covers ONLY the completed epoch and still closes
+        assert s["totals"]["recorded_wall_ms"] == pytest.approx(80.0)
+        assert s["totals"]["accounted_span_ms"] == pytest.approx(
+            20 * 3.0, rel=0.01)
+        assert sum(s["step_split_pct"].values()) == pytest.approx(
+            100.0, abs=0.1)
+        # the text report names it too
+        assert summarize(events)  # (idempotent)
+        assert telemetry_main(
+            ["summary", str(tmp_path / "telemetry_rank0.jsonl")]) == 0
+
+    def test_appended_relaunch_truncates_previous_segment(self):
+        """A relaunch APPENDS to the shared stream: the crashed previous
+        segment's orphan spans fold into the partial block at the meta
+        boundary instead of polluting the new segment's split."""
+        events = [
+            {"kind": "meta", "name": "stream", "schema": 2},
+            {"kind": "span", "name": "step_dispatch", "dur_ms": 5.0,
+             "step": 0},
+            # crash here — relaunch appends a fresh header
+            {"kind": "meta", "name": "stream", "schema": 2},
+            {"kind": "span", "name": "step_dispatch", "dur_ms": 7.0,
+             "step": 0},
+            {"kind": "counter", "name": "epoch_time_s", "value": 0.007},
+        ]
+        s = summarize(events)
+        assert s["partial_epoch"]["steps"] == 1
+        assert s["partial_epoch"]["total_ms"] == pytest.approx(5.0)
+        assert s["totals"]["accounted_span_ms"] == pytest.approx(7.0)
+
+    def test_complete_run_has_no_partial_block(self, tmp_path):
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        rec.span_event("step_dispatch", 0.002, step=0)
+        rec.counter("epoch_time_s", 0.002)
+        telemetry.reset()
+        events, _ = read_stream(str(tmp_path / "t.jsonl"))
+        assert summarize(events)["partial_epoch"] is None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,594 @@
+"""Fleet-wide observability plane (ISSUE 14): the aggregator's multi-
+gen/multi-rank merge (clock skew, missing streams, appended generations),
+the straggler detector's rank+phase attribution of an injected
+loader_stall, the stitched Perfetto timeline's pid/tid stability, the
+live /metrics + /healthz endpoint's scrape contract, and the
+StreamFollower's rotation-surviving tail.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from distributed_pytorch_training_tpu import telemetry
+from distributed_pytorch_training_tpu.telemetry.__main__ import (
+    main as telemetry_main, read_stream,
+)
+from distributed_pytorch_training_tpu.telemetry.aggregate import (
+    StreamFollower,
+    aggregate_streams,
+    detect_stragglers,
+    last_step_of,
+    split_streams,
+    stitch_perfetto,
+)
+
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_recorder():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _write_stream(path, gen, rank, *, anchor_ts, steps, stall_at=None,
+                  stall_s=1.5, dispatch_s=0.004, append=False,
+                  start_step=0, gauges=(), epoch_counter=True):
+    """A synthetic per-rank stream with the train loop's real shape:
+    per-step data_wait + step_dispatch spans (step-stamped), then the
+    epoch totals. ``anchor_ts`` simulates each host's own (possibly
+    skewed) wall clock."""
+    mode = "a" if append else "w"
+    ts = anchor_ts
+    with open(path, mode, encoding="utf-8") as f:
+        def emit(kind, name, **fields):
+            ev = {"v": 2, "ts": fields.pop("ts", ts), "kind": kind,
+                  "name": name, "gen": gen, "rank": rank, **fields}
+            f.write(json.dumps(ev, sort_keys=True) + "\n")
+
+        emit("meta", "stream", schema=2, run_id=f"g{gen}r{rank}",
+             pid=1000 + 10 * gen + rank)
+        wall = 0.0
+        for i in range(steps):
+            step = start_step + i
+            wait = stall_s if step == stall_at else 0.001
+            ts = anchor_ts + wall + wait
+            emit("span", "data_wait", t0=anchor_ts + wall,
+                 dur_ms=wait * 1e3, step=step)
+            wall += wait
+            ts = anchor_ts + wall + dispatch_s
+            emit("span", "step_dispatch", t0=anchor_ts + wall,
+                 dur_ms=dispatch_s * 1e3, step=step)
+            wall += dispatch_s
+        for name, value in gauges:
+            emit("gauge", name, value=value)
+        if epoch_counter:
+            emit("counter", "epoch_time_s", value=wall, epoch=0)
+            emit("counter", "steps", value=steps, epoch=0)
+        emit("counter", "wire_bytes_per_replica", value=1024 * steps,
+             tier="ici", axis="data")
+    return Path(path)
+
+
+# ---------------------------------------------------------------------------
+# aggregator
+# ---------------------------------------------------------------------------
+
+
+class TestAggregate:
+    def test_multi_rank_merge_with_clock_skew(self, tmp_path):
+        """Two ranks whose host clocks disagree by 1000s merge into one
+        summary with side-by-side splits; the skew never reaches the
+        comparison (durations are monotonic pairs, timelines re-anchor
+        per segment)."""
+        p0 = _write_stream(tmp_path / "telemetry_rank0.jsonl", 0, 0,
+                           anchor_ts=1_000.0, steps=10)
+        p1 = _write_stream(tmp_path / "telemetry_rank1.jsonl", 0, 1,
+                           anchor_ts=2_000.0, steps=10)  # +1000s skew
+        agg = aggregate_streams([p0, p1])
+        assert agg["n_streams"] == 2
+        assert agg["identities"] == [(0, 0), (0, 1)]
+        assert [s["steps"] for s in agg["streams"]] == [10.0, 10.0]
+        # identical workloads -> no straggler from the skew alone
+        assert agg["stragglers"] == []
+        # wire rollup sums across ranks, keyed by (name, tier, axis)
+        (row,) = agg["wire"]
+        assert row["tier"] == "ici" and row["axis"] == "data"
+        assert row["total"] == 2 * 1024 * 10
+
+    def test_one_stream_missing_is_reported_not_fatal(self, tmp_path):
+        p0 = _write_stream(tmp_path / "telemetry_rank0.jsonl", 0, 0,
+                           anchor_ts=0.0, steps=4)
+        agg = aggregate_streams([p0, tmp_path / "telemetry_rank1.jsonl"])
+        assert agg["n_streams"] == 1
+        assert agg["missing_streams"] == [
+            str(tmp_path / "telemetry_rank1.jsonl")]
+
+    def test_overlapping_generations_in_one_appended_file(self, tmp_path):
+        """The elastic-fleet shape: generation 1 APPENDS to the same
+        telemetry_rank0.jsonl after a relaunch, re-running overlapping
+        steps. The aggregator splits at the meta headers and reports both
+        segments separately, attributably."""
+        p = tmp_path / "telemetry_rank0.jsonl"
+        _write_stream(p, 0, 0, anchor_ts=10.0, steps=8)
+        _write_stream(p, 1, 0, anchor_ts=60.0, steps=8, start_step=4,
+                      append=True)  # overlaps steps 4..7
+        segments = split_streams([p])
+        assert [seg.key for seg in segments] == [(0, 0), (1, 0)]
+        agg = aggregate_streams([p])
+        assert agg["identities"] == [(0, 0), (1, 0)]
+        assert [s["gen"] for s in agg["streams"]] == [0, 1]
+
+    def test_aggregate_cli_json(self, tmp_path, capsys):
+        p0 = _write_stream(tmp_path / "a.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=4)
+        p1 = _write_stream(tmp_path / "b.jsonl", 1, 0, anchor_ts=5.0,
+                           steps=4)
+        assert telemetry_main(["aggregate", str(p0), str(p1),
+                               "--json"]) == 0
+        agg = json.loads(capsys.readouterr().out)
+        assert agg["kind"] == "fleet_summary" and agg["n_streams"] == 2
+        # human-readable form renders too
+        assert telemetry_main(["aggregate", str(p0), str(p1)]) == 0
+        assert "gen=1 rank=0" in capsys.readouterr().out
+        # nothing readable -> exit 1
+        assert telemetry_main(["aggregate",
+                               str(tmp_path / "nope.jsonl")]) == 1
+
+    def test_aggregate_output_path_honored_without_json_flag(
+            self, tmp_path, capsys):
+        """-o always writes the machine-readable body — a silently
+        ignored output path strands every script that reads it."""
+        p0 = _write_stream(tmp_path / "a.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=4)
+        out = tmp_path / "fleet.json"
+        assert telemetry_main(["aggregate", str(p0),
+                               "-o", str(out)]) == 0
+        assert json.loads(out.read_text())["kind"] == "fleet_summary"
+        # the human-readable summary still printed to stdout
+        assert "gen=0 rank=0" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+
+class TestStragglerDetector:
+    def test_one_rank_stall_is_rank_and_phase_attributed(self, tmp_path):
+        """The acceptance shape: rank 1 takes a 1.5s loader stall at step
+        6; the detector names the rank, the step AND the phase, against
+        its peers at the same step."""
+        p0 = _write_stream(tmp_path / "r0.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=12)
+        p1 = _write_stream(tmp_path / "r1.jsonl", 0, 1, anchor_ts=0.0,
+                           steps=12, stall_at=6)
+        stragglers = detect_stragglers(split_streams([p0, p1]))
+        assert len(stragglers) == 1
+        s = stragglers[0]
+        assert (s["gen"], s["rank"], s["step"], s["phase"]) == \
+            (0, 1, 6, "data_wait")
+        assert s["basis"] == "peers_at_step" and s["peers"] == 1
+        assert s["dur_s"] == pytest.approx(1.5)
+
+    def test_solo_segment_stall_falls_back_to_phase_median(self, tmp_path):
+        """Elastic overlap is partial: a stalled step no peer ran is
+        still attributed, against the phase's own cross-fleet median."""
+        p0 = _write_stream(tmp_path / "g0.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=8)
+        p1 = _write_stream(tmp_path / "g1.jsonl", 1, 0, anchor_ts=50.0,
+                           steps=4, start_step=20, stall_at=22)
+        (s,) = detect_stragglers(split_streams([p0, p1]))
+        assert (s["gen"], s["step"], s["phase"]) == (1, 22, "data_wait")
+        assert s["basis"] == "phase_median"
+
+    def test_first_dispatch_compile_is_not_a_straggler(self, tmp_path):
+        """Every relaunch's first step_dispatch carries the compile; the
+        detector's warm-up exemption keeps cold starts out of the
+        straggler table (data_wait has no such exemption)."""
+        p0 = _write_stream(tmp_path / "r0.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=10)
+        # rank 1's first dispatch is 3s (the compile), rest normal
+        p1 = tmp_path / "r1.jsonl"
+        _write_stream(p1, 0, 1, anchor_ts=0.0, steps=0,
+                      epoch_counter=False)
+        with open(p1, "a") as f:
+            for i in range(10):
+                f.write(json.dumps({
+                    "v": 2, "ts": float(i), "kind": "span",
+                    "name": "step_dispatch", "t0": float(i),
+                    "dur_ms": 3000.0 if i == 0 else 4.0, "step": i,
+                    "gen": 0, "rank": 1}) + "\n")
+        assert detect_stragglers(split_streams([p0, p1])) == []
+
+    def test_microsecond_noise_stays_below_the_floor(self, tmp_path):
+        """5x spread at sub-floor absolute durations is CPU-mesh noise,
+        not divergence."""
+        p0 = _write_stream(tmp_path / "r0.jsonl", 0, 0, anchor_ts=0.0,
+                           steps=10, dispatch_s=0.001)
+        p1 = _write_stream(tmp_path / "r1.jsonl", 0, 1, anchor_ts=0.0,
+                           steps=10, dispatch_s=0.02)  # 20x but 20ms
+        assert detect_stragglers(split_streams([p0, p1])) == []
+
+    def test_injected_loader_stall_through_the_real_loop(self, tmp_path,
+                                                        mesh8):
+        """End to end through the REAL instrumented train loop: two
+        mock-step epochs over the chaos rig, one with a loader_stall
+        fault injected into its ShardedLoader — the merged view must
+        attribute (gen=1, data_wait, the stalled step)."""
+        import jax.numpy as jnp
+
+        from distributed_pytorch_training_tpu.data.loader import (
+            ShardedLoader,
+        )
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            _build_rig,
+        )
+        from distributed_pytorch_training_tpu.resilience.faults import (
+            FaultInjector, FaultPlan,
+        )
+
+        metrics = {"loss_sum": jnp.float32(1.0),
+                   "correct": jnp.float32(1.0),
+                   "weight": jnp.float32(16.0)}
+
+        def run_child(gen, stream_path, fault_hook=None):
+            trainer, state_factory, loader = _build_rig(
+                mesh8, seed=0, dataset_size=320, per_device_batch=2)
+            trainer._train_step = lambda state, batch, key: (state,
+                                                             metrics)
+            if fault_hook is not None:
+                loader = ShardedLoader(loader.dataset, trainer.mesh, 2,
+                                       shuffle=True, seed=0,
+                                       fault_hook=fault_hook)
+            telemetry.configure(str(stream_path), gen=gen, rank=0)
+            spe = len(loader)
+            trainer.train_epoch(None, loader.epoch(0), 0, spe,
+                                samples_per_step=[16] * spe)
+            telemetry.reset()
+
+        p0 = tmp_path / "clean.jsonl"
+        p1 = tmp_path / "stalled.jsonl"
+        run_child(0, p0)
+        injector = FaultInjector(
+            FaultPlan.parse("loader_stall@step=8:0.6s"))
+        run_child(1, p1, fault_hook=injector.on_loader_batch)
+        assert injector.fired == ["loader_stall@step=8:0.6s"]
+
+        agg = aggregate_streams([p0, p1])
+        hits = [s for s in agg["stragglers"]
+                if s["phase"] == "data_wait" and s["gen"] == 1]
+        assert hits, agg["stragglers"]
+        assert hits[0]["dur_s"] >= 0.5
+        # and the clean child is never blamed
+        assert all(s["gen"] == 1 for s in agg["stragglers"])
+
+
+# ---------------------------------------------------------------------------
+# stitched Perfetto timeline
+# ---------------------------------------------------------------------------
+
+
+class TestStitchedPerfetto:
+    def _streams(self, tmp_path):
+        p = tmp_path / "telemetry_rank0.jsonl"
+        _write_stream(p, 0, 0, anchor_ts=1_000.0, steps=4,
+                      gauges=[("world_size", 8)])
+        _write_stream(p, 1, 0, anchor_ts=9_000.0, steps=4, append=True,
+                      gauges=[("world_size", 4)])
+        q = _write_stream(tmp_path / "telemetry_rank1.jsonl", 0, 1,
+                          anchor_ts=5_000.0, steps=4)
+        return [p, q]
+
+    def test_one_stable_pid_per_gen_rank(self, tmp_path):
+        paths = self._streams(tmp_path)
+        trace = stitch_perfetto(split_streams(paths))
+        names = {e["args"]["name"]: e["pid"]
+                 for e in trace["traceEvents"] if e["ph"] == "M"}
+        # exactly one pid/tid pair per (gen, rank), deterministically
+        # ordered by identity
+        assert names == {"gen0/rank0": 1, "gen0/rank1": 2,
+                         "gen1/rank0": 3}
+        span_keys = {(e["pid"], e["tid"])
+                     for e in trace["traceEvents"] if e["ph"] == "X"}
+        assert span_keys == {(1, 1), (2, 1), (3, 1)}
+        # stability: re-stitching (and reversing the file order) maps the
+        # same identities to the same pids
+        again = stitch_perfetto(split_streams(list(reversed(paths))))
+        names2 = {e["args"]["name"]: e["pid"]
+                  for e in again["traceEvents"] if e["ph"] == "M"}
+        assert names2 == names
+
+    def test_skew_normalized_to_each_meta_anchor(self, tmp_path):
+        """Anchors 1000s/5000s/9000s apart overlay near t=0: no span
+        starts more than the segment's own duration from zero."""
+        trace = stitch_perfetto(split_streams(self._streams(tmp_path)))
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert spans and all(0 <= e["ts"] < 60 * 1e6 for e in spans)
+        # the absolute wall clock survives in args for cross-referencing
+        assert all("wall_ts" in e["args"] for e in spans)
+
+    def test_gauges_become_counter_tracks(self, tmp_path):
+        trace = stitch_perfetto(split_streams(self._streams(tmp_path)))
+        counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+        assert {e["name"] for e in counters} == {"world_size"}
+        assert {e["args"]["value"] for e in counters} == {8.0, 4.0}
+
+    def test_multi_stream_export_cli(self, tmp_path):
+        paths = self._streams(tmp_path)
+        out = tmp_path / "trace.json"
+        assert telemetry_main(["export", str(paths[0]), str(paths[1]),
+                               "--perfetto", "-o", str(out)]) == 0
+        trace = json.loads(out.read_text())
+        pids = {e["pid"] for e in trace["traceEvents"]}
+        assert pids == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# /metrics + /healthz
+# ---------------------------------------------------------------------------
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+na-f]+$")
+
+
+def _scrape(port, path="/metrics"):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestMetricsEndpoint:
+    def test_scrape_is_prometheus_parseable_and_advances(self, tmp_path):
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        server = telemetry.MetricsServer(0, recorder=rec)
+        port = server.start()
+        try:
+            rec.span_event("step_dispatch", 0.004, step=0)
+            rec.span_event("data_wait", 0.001, step=0)
+            rec.counter("epoch_time_s", 0.005, epoch=0)
+            rec.counter("wire_bytes_per_replica", 2048, tier="ici",
+                        axis="data")
+            rec.counter("tp_psum_bytes_per_replica", 512, tier="ici",
+                        axis="model")
+            rec.gauge("world_size", 8)
+            rec.anomaly("loader_stall", step=3)
+            status, body = _scrape(port)
+            assert status == 200
+            for line in body.strip().splitlines():
+                if line.startswith("#"):
+                    continue
+                assert _PROM_LINE.match(line), line
+            assert "dpt_steps_total 1" in body
+            assert "dpt_last_step 0" in body
+            assert "dpt_epoch 0" in body
+            assert ('dpt_phase_seconds_count{phase="step_dispatch"} 1'
+                    in body)
+            assert ('dpt_wire_bytes_total{name="wire_bytes_per_replica"'
+                    ',tier="ici",axis="data"} 2048') in body
+            # the 2-D tier axis rolls in as one more label value
+            assert 'axis="model"} 512' in body
+            assert 'dpt_anomalies_total{name="loader_stall"} 1' in body
+            assert 'dpt_gauge{name="world_size"} 8' in body
+            # counters ADVANCE across scrapes while steps keep landing
+            rec.span_event("step_dispatch", 0.004, step=1)
+            _, body2 = _scrape(port)
+            assert "dpt_steps_total 2" in body2
+            assert "dpt_last_step 1" in body2
+        finally:
+            server.stop()
+
+    def test_healthz_flips_when_the_fence_stops(self, tmp_path):
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        server = telemetry.MetricsServer(0, recorder=rec,
+                                         stale_after_s=0.4)
+        port = server.start()
+        try:
+            rec.span_event("step_dispatch", 0.004, step=0)
+            status, body = _scrape(port, "/healthz")
+            assert status == 200 and json.loads(body)["healthy"] is True
+            time.sleep(0.6)   # the fence stops advancing
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(port, "/healthz")
+            assert err.value.code == 503
+            detail = json.loads(err.value.read().decode())
+            assert detail["healthy"] is False
+            assert detail["last_progress_age_s"] >= 0.4
+            # progress resumes -> healthy again
+            rec.span_event("step_dispatch", 0.004, step=1)
+            status, _ = _scrape(port, "/healthz")
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_off_means_zero_new_threads(self, tmp_path):
+        """The zero-when-off contract: an unset/zero port starts nothing
+        — no listener, no observer, no thread."""
+        before = set(threading.enumerate())
+        assert telemetry.resolve_metrics_port(None) == 0
+        assert telemetry.resolve_metrics_port(0) == 0
+        assert telemetry.start_metrics_server(0) is None
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        rec.span_event("step_dispatch", 0.004, step=0)
+        assert set(threading.enumerate()) == before
+        assert rec._observers == []
+
+    def test_port_resolution_env_and_rank_offset(self, monkeypatch):
+        monkeypatch.delenv(telemetry.METRICS_PORT_ENV, raising=False)
+        assert telemetry.resolve_metrics_port(None, rank=3) == 0
+        assert telemetry.resolve_metrics_port(9200, rank=3) == 9203
+        monkeypatch.setenv(telemetry.METRICS_PORT_ENV, "9100")
+        assert telemetry.resolve_metrics_port(None, rank=2) == 9102
+        # explicit CLI beats the env
+        assert telemetry.resolve_metrics_port(9300, rank=0) == 9300
+
+    def test_replayed_step_is_not_progress(self, tmp_path):
+        """A restart loop re-dispatching the SAME steps from a checkpoint
+        must not keep /healthz green: only an ADVANCING fence (a new
+        high-water step) refreshes the liveness probe."""
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        server = telemetry.MetricsServer(0, recorder=rec,
+                                         stale_after_s=0.4)
+        port = server.start()
+        try:
+            rec.span_event("step_dispatch", 0.004, step=5)
+            status, _ = _scrape(port, "/healthz")
+            assert status == 200
+            # keep re-dispatching step 5 (and older) past the fence age
+            deadline = time.monotonic() + 0.7
+            while time.monotonic() < deadline:
+                rec.span_event("step_dispatch", 0.004, step=5)
+                rec.span_event("step_dispatch", 0.004, step=3)
+                time.sleep(0.05)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _scrape(port, "/healthz")
+            assert err.value.code == 503
+            # a genuinely new step revives it
+            rec.span_event("step_dispatch", 0.004, step=6)
+            status, _ = _scrape(port, "/healthz")
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_bind_failure_never_raises_from_the_wiring(self, tmp_path,
+                                                       capsys):
+        """The train.py/serving entry path: a taken port returns None
+        (stderr-noted) instead of killing the run — the live surface
+        shares the recorder's never-take-the-run-down contract."""
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        holder = telemetry.MetricsServer(0, recorder=None)
+        port = holder.start()   # squat the port
+        try:
+            assert telemetry.start_metrics_server(port, rec) is None
+            assert "could not bind" in capsys.readouterr().err
+            assert rec._observers == []   # nothing half-attached
+        finally:
+            holder.stop()
+            telemetry.stop_metrics_server()
+
+    def test_observer_detaches_on_stop(self, tmp_path):
+        rec = telemetry.configure(str(tmp_path / "t.jsonl"))
+        server = telemetry.MetricsServer(0, recorder=rec)
+        server.start()
+        assert rec._observers
+        server.stop()
+        assert rec._observers == []
+        rec.counter("after", 1)  # no observer left to call
+
+
+# ---------------------------------------------------------------------------
+# StreamFollower: tail -f and the fleet's live progress probe
+# ---------------------------------------------------------------------------
+
+
+class TestStreamFollower:
+    def test_incremental_poll_and_partial_lines(self, tmp_path):
+        p = tmp_path / "s.jsonl"
+        follower = StreamFollower(p)
+        assert follower.poll() == []      # not created yet: not an error
+        with open(p, "w") as f:
+            f.write(json.dumps({"kind": "counter", "name": "a",
+                                "value": 1}) + "\n")
+            f.write('{"kind": "counter", "name": "b"')   # torn mid-write
+        evs = follower.poll()
+        assert [e["name"] for e in evs] == ["a"]
+        with open(p, "a") as f:
+            f.write(', "value": 2}\n')                   # line completes
+        assert [e["name"] for e in follower.poll()] == ["b"]
+
+    def test_rotation_to_a_new_stream_file(self, tmp_path):
+        p = tmp_path / "s.jsonl"
+        p.write_text(json.dumps({"kind": "counter", "name": "old",
+                                 "value": 1}) + "\n")
+        follower = StreamFollower(p)
+        assert [e["name"] for e in follower.poll()] == ["old"]
+        # rotate: a NEW file replaces the old path (new inode)
+        rotated = tmp_path / "rotated.jsonl"
+        rotated.write_text(json.dumps({"kind": "counter", "name": "new",
+                                       "value": 2}) + "\n")
+        rotated.replace(p)
+        assert [e["name"] for e in follower.poll()] == ["new"]
+
+    def test_last_step_probe(self, tmp_path):
+        p = _write_stream(tmp_path / "s.jsonl", 0, 0, anchor_ts=0.0,
+                          steps=5)
+        follower = StreamFollower(p)
+        assert last_step_of(follower.poll()) == 4
+        assert last_step_of([], prior=4) == 4
+
+    def test_last_step_probe_is_generation_scoped(self, tmp_path):
+        """On the shared appended stream, a previous generation's spans
+        must not read as THIS child's progress."""
+        p = _write_stream(tmp_path / "s.jsonl", 0, 0, anchor_ts=0.0,
+                          steps=9)
+        _write_stream(p, 1, 0, anchor_ts=50.0, steps=3, append=True)
+        events = StreamFollower(p).poll()
+        assert last_step_of(events, gen=1) == 2
+        assert last_step_of(events, gen=0) == 8
+        assert last_step_of(events, gen=2) == -1   # nothing of gen 2 yet
+
+    def test_start_at_end_skips_the_backlog(self, tmp_path):
+        """The fleet watch arms a follower on a file that already holds
+        earlier generations: start_at_end skips the backlog (no O(N^2)
+        re-parse per child) and still sees everything appended after."""
+        p = _write_stream(tmp_path / "s.jsonl", 0, 0, anchor_ts=0.0,
+                          steps=50)
+        follower = StreamFollower(p, start_at_end=True)
+        assert follower.poll() == []        # backlog skipped
+        _write_stream(p, 1, 0, anchor_ts=9.0, steps=2, append=True)
+        evs = follower.poll()
+        assert evs and all(e.get("gen") == 1 for e in evs)
+
+    def test_start_at_end_on_a_not_yet_created_file_skips_nothing(
+            self, tmp_path):
+        """The snapshot is taken at ARM time: a file created AFTERWARDS
+        (a fresh fleet run — gen 0's own stream) has no backlog, and the
+        child's first events are never discarded."""
+        p = tmp_path / "later.jsonl"
+        follower = StreamFollower(p, start_at_end=True)
+        assert follower.poll() == []
+        _write_stream(p, 0, 0, anchor_ts=0.0, steps=3)
+        evs = follower.poll()
+        assert last_step_of(evs, gen=0) == 2   # nothing was skipped
+
+    def test_importing_telemetry_does_not_load_metrics_http(self):
+        """metrics_http's zero-cost-when-off contract starts at import:
+        the package (the training hot path, the jax-free CLI readers)
+        resolves the live-surface names lazily, so the OFF path never
+        pays the http.server import (subprocess: this process's
+        sys.modules is already warm)."""
+        import subprocess
+        import sys as _sys
+        src = (
+            "import sys; sys.path.insert(0, " + repr(str(REPO)) + ")\n"
+            "import distributed_pytorch_training_tpu.telemetry\n"
+            "mod = 'distributed_pytorch_training_tpu.telemetry"
+            ".metrics_http'\n"
+            "assert mod not in sys.modules, 'eagerly imported'\n"
+            "import distributed_pytorch_training_tpu.telemetry as t\n"
+            "assert t.resolve_metrics_port(0) == 0\n"
+            "assert mod in sys.modules  # first use loads it\n")
+        r = subprocess.run([_sys.executable, "-c", src],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+
+    def test_tail_follow_cli_bounded(self, tmp_path, capsys):
+        p = _write_stream(tmp_path / "s.jsonl", 0, 0, anchor_ts=0.0,
+                          steps=3)
+        rc = telemetry_main(["tail", str(p), "-n", "2", "-f",
+                             "--poll-s", "0.05",
+                             "--follow-timeout", "0.2"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2              # the backlog tail
+        assert json.loads(out[-1])["kind"] == "counter"

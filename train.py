@@ -127,6 +127,12 @@ def main(argv=None):
         # later with no warning. Normal completion disarms after cleanup
         # inside _run; this is the exception path.
         guard.disarm()
+        # endpoint down before the stream closes — guarded on the module
+        # actually having loaded, so the metrics-off path never imports
+        # metrics_http at all (its zero-cost-when-off contract)
+        if "distributed_pytorch_training_tpu.telemetry.metrics_http" \
+                in sys.modules:
+            telemetry.stop_metrics_server()
         telemetry.reset()  # close the JSONL (fsync) and drop the global
 
 
@@ -156,16 +162,37 @@ def _run(args, guard):
         log_main(f"CHAOS: fault plan armed: {args.chaos}")
 
     ctx = setup_distributed()  # ref :318
-    # Structured run telemetry (telemetry/): process-0-only JSONL stream in
-    # the output dir + the in-memory ring the flight recorder flushes on
-    # abnormal exits. Host-side only — PARITY.md pins that the lowered HLO
-    # is identical with telemetry on or off.
+    # Structured run telemetry (telemetry/): per-rank JSONL stream in the
+    # output dir + the in-memory ring the flight recorder flushes on
+    # abnormal exits. Rank 0 always streams (the historical
+    # telemetry_rank0.jsonl, unchanged disk cost); other ranks stream
+    # only under --telemetry-all-ranks / DPT_TELEMETRY_ALL_RANKS — the
+    # per-rank inputs `telemetry aggregate` merges. Host-side only —
+    # PARITY.md pins that the lowered HLO is identical with telemetry on
+    # or off, live /metrics surface included.
     from distributed_pytorch_training_tpu import telemetry
-    if not args.no_telemetry and ctx.is_main:
+    tele_rank = telemetry.rank_identity(ctx.process_index)
+    if not args.no_telemetry and telemetry.should_stream(
+            tele_rank, args.telemetry_all_ranks):
         telemetry.configure(
-            str(Path(args.output_dir) / "telemetry_rank0.jsonl"),
+            str(Path(args.output_dir)
+                / telemetry.stream_filename(tele_rank)),
+            rank=tele_rank, gen=telemetry.generation_identity(),
             meta={"entry": "train.py", "model": args.model,
                   "mesh": args.mesh, "chaos": args.chaos or ""})
+    # Live metrics endpoint (telemetry/metrics_http.py): a stdlib-only
+    # background HTTP thread serving Prometheus /metrics + step-fence
+    # /healthz, fed by an observer on the recorder. Off (the default)
+    # resolves port 0 and starts ZERO threads.
+    metrics_port = telemetry.resolve_metrics_port(args.metrics_port,
+                                                  tele_rank)
+    if metrics_port and telemetry.is_configured():
+        # a bind failure returns None (stderr-noted) instead of raising:
+        # the live surface must never take the training run down
+        if telemetry.start_metrics_server(metrics_port,
+                                          telemetry.get()) is not None:
+            log_main(f"Telemetry: serving /metrics + /healthz on "
+                     f":{metrics_port}")
     # Relay-tunnel deathwatch (resilience/heartbeat.py, the layer bench.py
     # seeded): opt-in via DPT_RELAY_PORTS — on the tunneled single-chip
     # environment a dead relay turns every RPC into an unbounded
@@ -194,6 +221,9 @@ def _run(args, guard):
     mesh = build_mesh(MeshSpec.parse(args.mesh))
     n_batch_shards = batch_shard_count(mesh)
     global_batch = args.batch_size * n_batch_shards
+    # the /metrics world-size gauge (elastic relaunches land at different
+    # worlds — the scrape shows which one this process actually got)
+    telemetry.gauge("world_size", mesh.size)
     # Warm-restart compilation cache: reuse compiles across CLI invocations
     # AND across supervisor/elastic restarts (the TPU analogue of the
     # reference's cudnn.benchmark=True autotune persistence, ref :329).
