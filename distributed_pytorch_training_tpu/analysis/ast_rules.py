@@ -462,6 +462,53 @@ def check_no_bare_os_exit(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule: jax.profiler session entry points only via utils/profiling.py
+# ---------------------------------------------------------------------------
+
+# The one sanctioned home of the raw jax profiler session primitives
+# (StepProfiler + trace_session own the process-wide session guard).
+# Matched on exact trailing path COMPONENTS like OS_EXIT_HOME — a future
+# `myutils/profiling.py` must not inherit the exemption.
+PROFILER_HOME = ("utils", "profiling.py")
+
+_PROFILER_SESSION_NAMES = ("jax.profiler.start_trace",
+                           "jax.profiler.stop_trace")
+
+
+@rule("profiler-session-via-stepprofiler-only", "ast",
+      "jax.profiler.start_trace/stop_trace appear only in "
+      "utils/profiling.py",
+      "jax holds ONE profiler session per process: a second start_trace "
+      "while one is open raises from deep inside jax, and a leaked open "
+      "session silently fails every later capture — with ISSUE 15's "
+      "on-demand and anomaly-triggered captures, windows can now open at "
+      "RUNTIME from the HTTP thread and the watchdog, so every session "
+      "entry must route through utils/profiling.py's process-wide guard "
+      "(StepProfiler / trace_session), which refuses-and-counts "
+      "(`profiler_busy`) instead of crashing. A bare start_trace "
+      "anywhere else reintroduces the clobber.")
+def check_profiler_session_home(ctx: FileContext) -> List[Finding]:
+    if tuple(ctx.relpath.replace("\\", "/").split("/")[-2:]) \
+            == PROFILER_HOME:
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        # flag the reference itself (Name or Attribute), not just calls:
+        # `st = jax.profiler.start_trace` then `st(d)` is the same hazard
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            resolved = ctx.resolve(node)
+            if resolved in _PROFILER_SESSION_NAMES:
+                out.append(Finding(
+                    "profiler-session-via-stepprofiler-only",
+                    f"{resolved} outside utils/profiling.py — raw "
+                    "session entry points bypass the process-wide "
+                    "session guard (a concurrent on-demand capture would "
+                    "clobber it); use utils.profiling.StepProfiler or "
+                    "trace_session", ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 # The one sanctioned home of raw Pallas kernels: the package's ops/
 # directory (flash/ring/ulysses attention, the fused int8 quantize codecs).

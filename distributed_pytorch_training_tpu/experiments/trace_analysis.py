@@ -201,21 +201,127 @@ def comm_overlap_split(log_dir: str) -> dict:
     }
 
 
+def device_time_split(log_dir: str) -> dict:
+    """The four-way device-time attribution of one captured window
+    (ISSUE 15 — the number set telemetry/device.py turns into a typed
+    ``device_profile`` event):
+
+    * ``compute_us`` — op busy time that is neither communication nor
+      hidden under it,
+    * ``comm_hidden_us`` — collective time overlapping compute on the
+      same pid (XLA hid it),
+    * ``comm_exposed_us`` — collective time nothing overlapped (the
+      number that decides whether compressed sync paid off),
+    * ``host_gap_us`` — wall extent of the capture minus device busy
+      time (dispatch stalls, loader waits, host work).
+
+    The four numbers are UNION wall measures per pid (compute-only wall,
+    collective wall coinciding with compute, collective-only wall, idle
+    wall), so ``compute + hidden + exposed + gap == window`` holds
+    EXACTLY on any trace — including the CPU thunk pool, where 8 virtual
+    replicas' all-reduce events overlap each other on one pid and a
+    per-event sum (``comm_overlap_split``'s accounting, kept unchanged
+    for the bench) can exceed the wall. ``by_op`` stays per-event op
+    time (the collective rollup is op work, not wall share). On the CPU
+    backend the hidden/exposed numbers measure thunk concurrency, not
+    ICI overlap — the ``comm_overlap_split`` caveat applies unchanged.
+    """
+    events, pids, tids = load_trace(log_dir)
+    ops = xla_op_events(events, pids, tids)
+    coll_by_pid: Dict[int, List[Tuple[float, float]]] = {}
+    comp_by_pid: Dict[int, List[Tuple[float, float]]] = {}
+    by_op: Dict[str, float] = {}
+    for e in ops:
+        iv = (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
+        pid = e.get("pid")
+        name = _norm(e["name"])
+        m = _COLLECTIVE_RE.match(name)
+        if m:
+            coll_by_pid.setdefault(pid, []).append(iv)
+            by_op[m.group(1)] = by_op.get(m.group(1), 0.0) + (iv[1] - iv[0])
+        else:
+            comp_by_pid.setdefault(pid, []).append(iv)
+
+    def _merge(ivs: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+        ivs = sorted(ivs)
+        out: List[Tuple[float, float]] = []
+        for a, b in ivs:
+            if out and a <= out[-1][1]:
+                out[-1] = (out[-1][0], max(out[-1][1], b))
+            else:
+                out.append((a, b))
+        return out
+
+    def _length(ivs: List[Tuple[float, float]]) -> float:
+        return sum(b - a for a, b in ivs)
+
+    def _intersect_len(xs: List[Tuple[float, float]],
+                       ys: List[Tuple[float, float]]) -> float:
+        total = 0.0
+        i = j = 0
+        while i < len(xs) and j < len(ys):
+            a = max(xs[i][0], ys[j][0])
+            b = min(xs[i][1], ys[j][1])
+            if b > a:
+                total += b - a
+            if xs[i][1] <= ys[j][1]:
+                i += 1
+            else:
+                j += 1
+        return total
+
+    window = compute = hidden = exposed = gap = coll_total = 0.0
+    for pid in set(coll_by_pid) | set(comp_by_pid):
+        comp = _merge(comp_by_pid.get(pid, []))
+        coll = _merge(coll_by_pid.get(pid, []))
+        every = _merge(comp + coll)
+        if not every:
+            continue
+        extent = every[-1][1] - every[0][0]
+        busy = _length(every)
+        c_len, k_len = _length(comp), _length(coll)
+        overlap = _intersect_len(comp, coll)
+        window += extent
+        compute += c_len - overlap
+        hidden += overlap
+        exposed += k_len - overlap
+        gap += extent - busy
+        coll_total += k_len
+    return {
+        "window_us": round(window, 1),
+        "compute_us": round(compute, 1),
+        "comm_hidden_us": round(hidden, 1),
+        "comm_exposed_us": round(exposed, 1),
+        "host_gap_us": round(gap, 1),
+        "collective_us": round(coll_total, 1),
+        "exposed_frac_pct": round(100.0 * exposed / coll_total, 2)
+        if coll_total else 0.0,
+        "by_op": {k: round(v, 1) for k, v in sorted(by_op.items())},
+        "n_device_lanes": len(set(coll_by_pid) | set(comp_by_pid)),
+    }
+
+
 def capture_step_trace(step_fn, state, batch, key, log_dir: str,
                        steps: int = 3):
     """Run `steps` executions of a compiled/jitted train step under a
     jax.profiler trace (call AFTER warmup so compile time stays out of the
-    window). Returns the final state."""
+    window). Returns the final state. Rides utils/profiling's session
+    guard: a concurrently-open session refuses loudly instead of raising
+    from deep inside jax."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
-    try:
+    from ..utils.profiling import trace_session
+
+    with trace_session(log_dir, owner="capture_step_trace") as started:
+        if not started:
+            raise RuntimeError(
+                "capture_step_trace: a jax profiler session is already "
+                "open in this process — stop it (StepProfiler window / "
+                "on-demand capture) before capturing a bench trace")
         metrics = None
         for _ in range(steps):
             state, metrics = step_fn(state, batch, key)
         if metrics is not None:
             jax.block_until_ready(metrics)
             float(jax.device_get(metrics["weight"]))  # true completion sync
-    finally:
-        jax.profiler.stop_trace()
     return state

@@ -217,6 +217,14 @@ def _add_fleet_args(p: argparse.ArgumentParser) -> None:
                         "/healthz; the orchestrator smoke-scrapes it "
                         "while children run (telemetry/metrics_http.py). "
                         "Default off")
+    p.add_argument("--federation-port", type=int, default=None,
+                   help="fleet: additionally run ONE federated /metrics "
+                        "fan-in on this port for the whole run "
+                        "(telemetry/metrics_http.FederationServer): "
+                        "every child series re-labelled with its "
+                        "gen/rank (read from the child's own "
+                        "dpt_build_info), exited generations kept in "
+                        "the merge marked down. Requires --metrics-port")
 
 
 def main(argv: Optional[List[str]] = None) -> int:

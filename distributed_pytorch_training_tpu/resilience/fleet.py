@@ -178,6 +178,15 @@ class FleetOrchestrator:
     ``metrics_port`` stamps ``DPT_METRICS_PORT`` (+rank offset) into the
     child env so every child serves /metrics + /healthz, and the watch
     loop smoke-scrapes it (``launch.metrics_ok``).
+
+    Federation (ISSUE 15): ``federation_port`` additionally runs ONE
+    fan-in proxy (telemetry/metrics_http.FederationServer) over the
+    children's per-rank ports for the whole fleet run — a single
+    Prometheus scrape target whose every series is gen/rank-labelled
+    (identities read from each child's own ``dpt_build_info``), with
+    exited generations' last pages kept in the merge marked down. The
+    final merged page lands in ``self.federation_page`` after
+    :meth:`run`.
     """
 
     def __init__(self, argv_for: Callable[..., List[str]], ckpt_dir,
@@ -190,6 +199,7 @@ class FleetOrchestrator:
                  log_dir=None,
                  telemetry_dir=None,
                  metrics_port: Optional[int] = None,
+                 federation_port: Optional[int] = None,
                  progress_poll_s: float = 0.5,
                  log: Callable[[str], None] = _stderr_log):
         if max_launches < 1:
@@ -210,6 +220,8 @@ class FleetOrchestrator:
         self.telemetry_dir = (Path(telemetry_dir)
                               if telemetry_dir is not None else None)
         self.metrics_port = metrics_port
+        self.federation_port = federation_port
+        self.federation_page: Optional[str] = None
         self.progress_poll_s = float(progress_poll_s)
         self.log = log
 
@@ -251,18 +263,12 @@ class FleetOrchestrator:
         return "crashed"
 
     def _scrape_metrics(self, port: int) -> Optional[str]:
-        """One best-effort /metrics scrape of a running child (stdlib
-        urllib, sub-second timeout — a child mid-compile simply has no
+        """One best-effort /metrics scrape of a running child — the
+        shared telemetry helper (a child mid-compile simply has no
         listener yet and that is not an error)."""
-        import urllib.error
-        import urllib.request
-        try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/metrics",
-                    timeout=0.8) as resp:
-                return resp.read().decode("utf-8", errors="replace")
-        except (urllib.error.URLError, OSError, ValueError):
-            return None
+        from ..telemetry.metrics_http import scrape_metrics
+
+        return scrape_metrics(port)
 
     def _watch_child(self, proc: "subprocess.Popen", launch: FleetLaunch,
                      generation: int) -> None:
@@ -314,6 +320,36 @@ class FleetOrchestrator:
     def run(self) -> FleetReport:
         report = FleetReport(target_step=self.target_step)
         self.log_dir.mkdir(parents=True, exist_ok=True)
+        federation = None
+        if self.federation_port and self.metrics_port:
+            from ..telemetry.metrics_http import FederationServer
+
+            # background refresh faster than the child watch poll: a
+            # short-lived generation must still land in the cache before
+            # it exits (the final merged page carries every generation)
+            federation = FederationServer(
+                int(self.federation_port), targets=[int(self.metrics_port)],
+                refresh_s=min(0.3, self.progress_poll_s))
+            try:
+                port = federation.start()
+                self.log(f"fleet: federated /metrics on :{port} "
+                         f"(fan-in over child port {self.metrics_port})")
+            except OSError as e:
+                self.log(f"fleet: federation port "
+                         f"{self.federation_port} could not bind ({e}) — "
+                         "continuing without the fan-in")
+                federation = None
+        try:
+            return self._run_generations(report)
+        finally:
+            if federation is not None:
+                # one last fan-out so a child that exited between polls
+                # is still merged, then keep the final page for the CLI
+                federation.refresh()
+                self.federation_page = federation.render()
+                federation.stop()
+
+    def _run_generations(self, report: FleetReport) -> FleetReport:
         for generation in range(self.max_launches):
             available = int(self._capacity(generation))
             world = plan_elastic_world(available, self.global_batch)
@@ -557,6 +593,10 @@ def fleet_main(args) -> int:
     ``--no-verify-parity``) the final checkpoint is bitwise-equal to an
     uninterrupted control child continuing from the last relaunch
     point."""
+    if getattr(args, "federation_port", None) \
+            and not getattr(args, "metrics_port", None):
+        raise SystemExit("--federation-port requires --metrics-port (the "
+                         "fan-in proxies the children's per-rank ports)")
     base = Path(args.ckpt_dir or tempfile.mkdtemp(prefix="dpt-fleet-"))
     base.mkdir(parents=True, exist_ok=True)
     ckpt_dir = base / "ckpt"
@@ -594,7 +634,8 @@ def fleet_main(args) -> int:
         target_step=target_step, capacity_for=capacity,
         max_launches=args.max_launches, on_child_exit=snapshot,
         telemetry_dir=out_dir,
-        metrics_port=getattr(args, "metrics_port", None))
+        metrics_port=getattr(args, "metrics_port", None),
+        federation_port=getattr(args, "federation_port", None))
     # flights already present belong to a PREVIOUS fleet run over this
     # --ckpt-dir — excluded from this run's per-generation accounting
     pre_existing_flights = set(Path(out_dir).glob("flight_*.json"))
@@ -658,6 +699,46 @@ def fleet_main(args) -> int:
                 "--metrics-port was set but no child's /metrics endpoint "
                 "ever answered a scrape with the step counter")
 
+    # the gen-2 straggler verdict's device upgrade (ISSUE 15): recorded,
+    # never gated — span-based attribution is the contractual fallback
+    # when no capture overlapped the flagged step
+    straggler_device_attributed = None
+    if stall_gens:
+        straggler_device_attributed = any(
+            s.get("device") for s in (fleet_summary or {})
+            .get("stragglers", []) if s["gen"] in stall_gens)
+
+    # federation (ISSUE 15): the run must end with ONE merged page whose
+    # per-rank series are gen/rank-labelled — every generation that
+    # provably served /metrics while alive must appear in it
+    federation_ok = None
+    federation_page_path = None
+    federated_identities: List[List[str]] = []
+    if getattr(args, "federation_port", None):
+        page = orch.federation_page or ""
+        if page:
+            federation_page_path = base / "fleet_metrics.prom"
+            federation_page_path.write_text(page)
+        import re as _re
+
+        federated_identities = sorted(
+            {(m.group(1), m.group(2)) for m in _re.finditer(
+                r'dpt_steps_total\{gen="([^"]*)",rank="([^"]*)"\}', page)})
+        federated_identities = [list(t) for t in federated_identities]
+        scraped_gens = {str(launch["generation"])
+                        for launch in report.launches
+                        if launch.get("metrics_ok")}
+        merged_gens = {g for g, _ in
+                       (tuple(t) for t in federated_identities)}
+        federation_ok = bool(federated_identities) \
+            and scraped_gens <= merged_gens
+        if not federation_ok:
+            report.errors.append(
+                "--federation-port was set but the merged /metrics page "
+                f"is missing gen/rank-labelled step rows (merged gens "
+                f"{sorted(merged_gens)}, scraped gens "
+                f"{sorted(scraped_gens)})")
+
     parity = None
     if (report.completed and not args.no_verify_parity
             and len(report.launches) > 1):
@@ -714,7 +795,12 @@ def fleet_main(args) -> int:
              "fleet_trace_path": str(trace_path) if trace_path else None,
              "stragglers": (fleet_summary or {}).get("stragglers", []),
              "straggler_attributed": straggler_attributed,
+             "straggler_device_attributed": straggler_device_attributed,
              "metrics_smoke": metrics_smoke,
+             "federation_ok": federation_ok,
+             "federated_identities": federated_identities,
+             "federation_page_path": (str(federation_page_path)
+                                      if federation_page_path else None),
              **flight_stats, **report.as_dict()}
     ok = (report.completed and parity is not False
           and flight_stats["flights_ok"]
@@ -722,6 +808,7 @@ def fleet_main(args) -> int:
           and not (gen_chaos and report.relaunches == 0)
           and straggler_attributed is not False
           and metrics_smoke is not False
+          and federation_ok is not False
           and (args.no_verify_parity or report.relaunches == 0
                or parity is True))
     if args.as_json:
@@ -750,6 +837,12 @@ def fleet_main(args) -> int:
                       f"({s['factor']}x {s['basis']})")
         if metrics_smoke is not None:
             print(f"metrics_smoke: {metrics_smoke}")
+        if federation_ok is not None:
+            print(f"federation: ok={federation_ok} identities="
+                  f"{federated_identities} page={federation_page_path}")
+        if straggler_device_attributed is not None:
+            print(f"straggler_device_attributed: "
+                  f"{straggler_device_attributed}")
         print(f"parity_bitwise: {parity}")
         for err in report.errors:
             print(f"error: {err}", file=sys.stderr)

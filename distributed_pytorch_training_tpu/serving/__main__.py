@@ -146,9 +146,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                                   tele_rank)
     if metrics_port and telemetry.is_configured():
         # None on a bind failure (stderr-noted): the live surface never
-        # takes the serving process down
-        if telemetry.start_metrics_server(metrics_port,
-                                          telemetry.get()) is not None:
+        # takes the serving process down. backend stamps dpt_build_info
+        # (the federated-scrape identity satellite, ISSUE 15).
+        import jax
+
+        if telemetry.start_metrics_server(
+                metrics_port, telemetry.get(),
+                backend=jax.default_backend()) is not None:
             log_main(f"serving: /metrics + /healthz on :{metrics_port}")
     Deathwatch.arm(log=log_main)
 

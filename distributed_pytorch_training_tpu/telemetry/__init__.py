@@ -82,7 +82,8 @@ from .watchdog import AnomalyAbort, AnomalyWatchdog  # noqa: F401
 # without paying for http.server, and the first actual use (train.py's
 # port wiring, a test) triggers the real import.
 _METRICS_EXPORTS = frozenset({
-    "METRICS_PORT_ENV", "MetricsServer", "resolve_metrics_port",
+    "METRICS_PORT_ENV", "MetricsServer", "FederationServer",
+    "get_metrics_server", "resolve_metrics_port",
     "start_metrics_server", "stop_metrics_server",
 })
 

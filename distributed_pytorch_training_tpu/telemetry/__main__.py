@@ -98,6 +98,7 @@ def summarize(events: List[dict]) -> dict:
     counters: dict = defaultdict(float)
     gauges: dict = {}
     anomalies: List[dict] = []
+    device_profiles: List[dict] = []
     meta: Optional[dict] = None
     # in-epoch spans seen since the last epoch_time_s counter: folded into
     # the accounted split by that counter's arrival, or into the PARTIAL
@@ -139,6 +140,8 @@ def summarize(events: List[dict]) -> dict:
             gauges[name] = ev.get("value")
         elif kind == "anomaly":
             anomalies.append(ev)
+        elif kind == "device_profile":
+            device_profiles.append(ev)
         elif kind == "meta":
             # a relaunch appended to the same stream: whatever the
             # previous run left pending was truncated, not completed
@@ -171,6 +174,46 @@ def summarize(events: List[dict]) -> dict:
             split["unaccounted"] = round(
                 100.0 * (wall_ms - accounted_ms) / base, 2)
 
+    # device-time attribution (ISSUE 15): the profiled windows' device
+    # split, rendered BESIDE the wall-clock split — summed over every
+    # device_profile event on the stream (the on-demand/anomaly captures
+    # plus the static window), with the per-window step ranges kept so a
+    # reader can line a window up against the straggler table
+    device = None
+    if device_profiles:
+        from .device import DEVICE_PHASES, split_of_event
+
+        split_ms = {p: 0.0 for p in DEVICE_PHASES}
+        window_ms = coll_ms = exposed_ms = 0.0
+        by_op: dict = defaultdict(float)
+        windows = []
+        for ev in device_profiles:
+            for phase, ms in split_of_event(ev).items():
+                split_ms[phase] += ms
+            window_ms += float(ev.get("window_ms", 0.0))
+            exposed_ms += float(ev.get("comm_exposed_ms", 0.0))
+            coll_ms += (float(ev.get("comm_exposed_ms", 0.0))
+                        + float(ev.get("comm_hidden_ms", 0.0)))
+            for op, ms in (ev.get("by_op_ms") or {}).items():
+                by_op[op] += float(ms)
+            windows.append({k: ev.get(k) for k in
+                            ("start_step", "stop_step", "steps", "reason",
+                             "trigger_step", "measured_mfu_pct")
+                            if ev.get(k) is not None})
+        device = {
+            "profiles": len(device_profiles),
+            "window_ms": round(window_ms, 3),
+            "split_ms": {p: round(v, 3) for p, v in split_ms.items()},
+            "split_pct": {p: round(100.0 * v / window_ms, 2)
+                          for p, v in split_ms.items()} if window_ms
+            else {},
+            "exposed_comm_ratio": round(exposed_ms / coll_ms, 4)
+            if coll_ms else 0.0,
+            "by_op_ms": {op: round(v, 3)
+                         for op, v in sorted(by_op.items())},
+            "windows": windows,
+        }
+
     partial_total = sum(partial_ms.values())
     partial_epoch = None
     if partial_steps or partial_total > 0.0:
@@ -198,6 +241,7 @@ def summarize(events: List[dict]) -> dict:
                           if k not in ("v", "ts", "kind", "name")}}
                       for a in anomalies],
         "step_split_pct": split,
+        "device": device,
         "partial_epoch": partial_epoch,
         "totals": {
             "recorded_wall_ms": round(wall_ms, 3),
@@ -267,6 +311,25 @@ def _print_summary(s: dict) -> None:
     if "wire" in s:
         for k, v in s["wire"].items():
             print(f"wire: {k} = {v}")
+    if s.get("device"):
+        d = s["device"]
+        print(f"device-time split ({d['profiles']} profiled window(s), "
+              f"{d['window_ms']:.1f} ms of device window):")
+        for phase, pct in sorted(d["split_pct"].items(),
+                                 key=lambda kv: -kv[1]):
+            print(f"  {phase:16s} {pct:6.2f}%  "
+                  f"({d['split_ms'][phase]:.1f} ms)")
+        print(f"  exposed-comm ratio: {d['exposed_comm_ratio']:.3f}")
+        for op, ms in d["by_op_ms"].items():
+            print(f"  collective: {op} = {ms:.1f} ms")
+        for w in d["windows"]:
+            rng = (f"steps {w.get('start_step')}-{w.get('stop_step')}"
+                   if w.get("start_step") is not None else "untracked")
+            trig = (f", trigger step {w['trigger_step']}"
+                    if w.get("trigger_step") is not None else "")
+            mfu = (f", measured MFU {w['measured_mfu_pct']:.1f}%"
+                   if w.get("measured_mfu_pct") is not None else "")
+            print(f"  window: {rng} ({w.get('reason', '?')}{trig}{mfu})")
     if s.get("partial_epoch"):
         pe = s["partial_epoch"]
         phases = ", ".join(f"{n} {v:.1f}ms"

@@ -142,14 +142,28 @@ def detect_stragglers(segments: List[StreamSegment],
     first dispatch is compile-dominated by construction (the watchdog's
     warm-up rule, applied cross-stream) and naming every generation's
     cold start a straggler would bury the real ones. Sorted worst-first
-    by excess duration."""
+    by excess duration.
+
+    Device attribution (ISSUE 15): when the flagged segment carries a
+    ``device_profile`` event covering the flagged step (the window
+    contains it, or the capture was anomaly-TRIGGERED by it —
+    telemetry/device.covers_step), the straggler row gains a ``device``
+    block: the captured split, the dominant collective op, and — when
+    OTHER segments profiled too — the exposed-comm factor vs the fleet
+    median ("rank 3 slow at step 12: exposed all-reduce 4.1x fleet
+    median"). Span-based attribution is unchanged and remains the
+    fallback when no capture overlapped."""
     # (phase, step) -> [(dur_s, segment)]
     by_step: Dict[Tuple[str, int], List[Tuple[float, StreamSegment]]] = \
         defaultdict(list)
     phase_all: Dict[str, List[float]] = defaultdict(list)
+    profiles: Dict[Tuple[int, int], List[dict]] = defaultdict(list)
     for seg in segments:
         seen_dispatch = False
         for ev in seg.events:
+            if ev.get("kind") == "device_profile":
+                profiles[seg.key].append(ev)
+                continue
             if ev.get("kind") != "span" or ev.get("name") not in phases:
                 continue
             if ev["name"] == "step_dispatch" and not seen_dispatch:
@@ -176,16 +190,55 @@ def detect_stragglers(segments: List[StreamSegment],
             baseline = statistics.median(others)
             basis = "phase_median"
         if dur_s > abs_floor_s and dur_s > rel_factor * max(baseline, 1e-9):
-            out.append({
+            row = {
                 "gen": seg.gen, "rank": seg.rank, "step": step,
                 "phase": phase,
                 "dur_s": round(dur_s, 4),
                 "baseline_s": round(baseline, 6),
                 "factor": round(dur_s / max(baseline, 1e-9), 1),
                 "basis": basis, "peers": len(peers),
-            })
+            }
+            device = _device_attribution(profiles, seg.key, step)
+            if device is not None:
+                row["device"] = device
+            out.append(row)
     out.sort(key=lambda s: -(s["dur_s"] - s["baseline_s"]))
     return out
+
+
+def _device_attribution(profiles: Dict[Tuple[int, int], List[dict]],
+                        key: Tuple[int, int], step: int) -> Optional[dict]:
+    """The straggler row's device block: the flagged segment's covering
+    profile, plus the exposed-comm factor vs the fleet median of the
+    OTHER segments' profiles (when any exist to compare against)."""
+    from .device import covers_step, split_of_event
+
+    mine = next((p for p in profiles.get(key, ())
+                 if covers_step(p, step)), None)
+    if mine is None:
+        return None
+    split = split_of_event(mine)
+    by_op = mine.get("by_op_ms") or {}
+    device = {
+        "split_ms": {p: round(v, 3) for p, v in split.items()},
+        "window_ms": round(float(mine.get("window_ms", 0.0)), 3),
+        "exposed_comm_ratio": mine.get("exposed_comm_ratio"),
+        "reason": mine.get("reason"),
+        "trigger_step": mine.get("trigger_step"),
+    }
+    if by_op:
+        device["dominant_op"] = max(by_op, key=lambda k: by_op[k])
+    peer_exposed = [float(p.get("comm_exposed_ms", 0.0))
+                    for k, plist in profiles.items() if k != key
+                    for p in plist]
+    if peer_exposed:
+        med = statistics.median(peer_exposed)
+        if med > 0:
+            device["exposed_vs_fleet_median"] = round(
+                split["comm_exposed"] / med, 1)
+        # med == 0 (peers fully hidden their comm): a ratio would be
+        # meaningless noise — the absolute split above is the evidence
+    return device
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +286,8 @@ def aggregate_segments(segments: List[StreamSegment], *,
             "accounted_span_ms": s["totals"]["accounted_span_ms"],
             "partial_epoch": s.get("partial_epoch"),
             "anomaly_count": len(s["anomalies"]),
+            # the device-time split beside the wall-clock one (ISSUE 15)
+            "device": s.get("device"),
         })
         for ev in seg.events:
             kind = ev.get("kind")
@@ -277,6 +332,14 @@ def print_fleet_summary(agg: dict) -> None:
         print(f"  gen={s['gen']} rank={s['rank']}: "
               f"{s['steps']:.0f} steps, wall "
               f"{s['recorded_wall_ms']:.0f}ms — {split}{partial}")
+        if s.get("device"):
+            d = s["device"]
+            dev_split = " ".join(
+                f"{n}={p:.1f}%" for n, p in
+                sorted(d["split_pct"].items(), key=lambda kv: -kv[1]))
+            print(f"    device ({d['profiles']} window(s), "
+                  f"{d['window_ms']:.0f}ms): {dev_split} "
+                  f"exposed_ratio={d['exposed_comm_ratio']:.3f}")
     for w in agg["wire"]:
         tier = f" tier={w['tier']}" if w["tier"] else ""
         axis = f" axis={w['axis']}" if w["axis"] else ""
@@ -293,6 +356,16 @@ def print_fleet_summary(agg: dict) -> None:
             print(f"    gen={s['gen']} rank={s['rank']} step={s['step']} "
                   f"{s['phase']} {s['dur_s']:.3f}s "
                   f"({s['factor']}x {s['basis']})")
+            if s.get("device"):
+                d = s["device"]
+                vs = (f" {d['exposed_vs_fleet_median']}x fleet median"
+                      if "exposed_vs_fleet_median" in d else "")
+                op = (f" {d['dominant_op']}" if "dominant_op" in d else "")
+                print(f"      device: exposed{op} "
+                      f"{d['split_ms']['comm_exposed']:.1f}ms{vs} "
+                      f"(compute {d['split_ms']['compute']:.1f}ms, "
+                      f"host gap {d['split_ms']['host_gap']:.1f}ms; "
+                      f"capture: {d.get('reason', '?')})")
     for path in agg["missing_streams"]:
         print(f"  note: unreadable/empty stream skipped: {path}")
 
@@ -343,6 +416,15 @@ def stitch_perfetto(segments: List[StreamSegment]) -> dict:
                 trace.append({"ph": "C", "pid": pid,
                               "name": ev.get("name", "?"), "ts": rel_us,
                               "args": {"value": value}})
+            elif kind == "device_profile":
+                # the device split beside the host spans: one X event on
+                # tid 2 spanning the captured window (the event's ts is
+                # ingestion time — just after the window closed, so the
+                # window is drawn ending there)
+                window_us = float(ev.get("window_ms", 0.0)) * 1e3
+                trace.append({**common, "tid": 2, "ph": "X",
+                              "ts": rel_us - window_us,
+                              "dur": window_us})
             else:
                 trace.append({**common, "ph": "i", "s": "p",
                               "ts": rel_us})

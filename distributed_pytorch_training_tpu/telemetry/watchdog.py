@@ -16,17 +16,56 @@ under the restart Supervisor is a restartable failure like any other, so
   multiple of its own rolling median (the chaos ``loader_stall`` fault's
   signature).
 
-The watchdog holds no device state and is jax-free.
+Anomaly-triggered capture (ISSUE 15): with a ``capture_hook`` installed
+(train.py wires it to ``StepProfiler.request_capture``), a step-time
+spike or loader stall ARMS a short on-demand trace capture the moment it
+is detected — the straggling behaviour is recorded while it is still
+happening instead of being unreproducible after the fact. The hook fires
+on detection regardless of the abort flag (and BEFORE an abort raise),
+is contained (a failing hook never takes the run down), and arming is
+refuse-not-clobber when the profiler is busy — so the hook has no
+``--telemetry-abort``-like side effects on control flow.
+
+The watchdog holds no device state and is jax-free. The detector knobs
+read env overrides via :func:`kwargs_from_env` (``DPT_WATCHDOG_*``) so
+an orchestrator can tune warm-up/floors on children it cannot pass
+flags to (the fleet's capture story needs a short warm-up on short
+runs).
 """
 
 from __future__ import annotations
 
 import collections
 import math
+import os
 import statistics
-from typing import Deque, Optional
+from typing import Callable, Deque, Optional
 
 from . import recorder as _recorder
+
+# env-name -> (ctor kwarg, cast): the orchestrator-facing tuning surface
+WATCHDOG_ENV_KNOBS = {
+    "DPT_WATCHDOG_MIN_SAMPLES": ("min_samples", int),
+    "DPT_WATCHDOG_SPIKE_FACTOR": ("spike_factor", float),
+    "DPT_WATCHDOG_STALL_FACTOR": ("stall_factor", float),
+    "DPT_WATCHDOG_STALL_MIN_S": ("stall_min_s", float),
+    "DPT_WATCHDOG_STALL_ABS_S": ("stall_abs_s", float),
+}
+
+
+def kwargs_from_env() -> dict:
+    """AnomalyWatchdog constructor overrides from ``DPT_WATCHDOG_*`` env
+    (unset/unparseable names are simply absent — defaults hold)."""
+    out = {}
+    for env, (kwarg, cast) in WATCHDOG_ENV_KNOBS.items():
+        raw = os.environ.get(env)
+        if raw is None:
+            continue
+        try:
+            out[kwarg] = cast(raw)
+        except ValueError:
+            pass
+    return out
 
 
 class AnomalyAbort(RuntimeError):
@@ -41,29 +80,58 @@ class AnomalyWatchdog:
     ``min_samples`` warm-up steps) is a ``step_time_spike``.
     ``stall_factor`` / ``stall_min_s``: a data wait above BOTH
     ``stall_min_s`` and factor x its median is a ``loader_stall``.
+    ``stall_abs_s`` (default None = off): an UNCONDITIONAL absolute
+    stall bound — a data wait above it is a ``loader_stall`` with no
+    warm-up and no median (a stall on the FIRST post-resume step is
+    otherwise invisible: the rolling median has nothing to compare
+    against; the fleet's anomaly-capture story needs exactly that step).
+    The caller owns the bound's sanity — None keeps the PR 8 semantics
+    bit-for-bit.
     ``abort``: raise :class:`AnomalyAbort` on detection (default: observe
-    only). Detections are also counted on the instance for tests/reports.
+    only). ``capture_hook(name, step)``: arm an on-demand trace capture
+    on a timing anomaly (spike/stall — not the non-finite-loss detector,
+    whose damage a device trace cannot show). Detections are also
+    counted on the instance for tests/reports.
     """
 
     def __init__(self, spike_factor: float = 5.0, min_samples: int = 20,
                  stall_factor: float = 10.0, stall_min_s: float = 1.0,
-                 window: int = 128, abort: bool = False):
+                 window: int = 128, abort: bool = False,
+                 capture_hook: Optional[Callable[[str, int],
+                                                 object]] = None,
+                 stall_abs_s: Optional[float] = None):
         if spike_factor <= 1.0 or stall_factor <= 1.0:
             raise ValueError("spike/stall factors must be > 1")
+        if stall_abs_s is not None and stall_abs_s <= 0:
+            raise ValueError("stall_abs_s must be > 0 (or None = off)")
         self.spike_factor = spike_factor
         self.min_samples = max(2, min_samples)
         self.stall_factor = stall_factor
         self.stall_min_s = stall_min_s
+        self.stall_abs_s = stall_abs_s
         self.abort = abort
+        self.capture_hook = capture_hook
         self._step_s: Deque[float] = collections.deque(maxlen=window)
         self._wait_s: Deque[float] = collections.deque(maxlen=window)
         self.anomalies: list = []
 
     # -- detections --------------------------------------------------------
 
+    # the timing anomalies a device trace can explain; non_finite_loss is
+    # a numerics problem, not a schedule one — no capture armed for it
+    _CAPTURE_ANOMALIES = ("step_time_spike", "loader_stall")
+
     def _fire(self, name: str, **fields) -> None:
         self.anomalies.append((name, fields))
         _recorder.emit("anomaly", name, **fields)
+        if self.capture_hook is not None and name in self._CAPTURE_ANOMALIES:
+            # BEFORE a potential abort-raise: the capture of the
+            # anomalous behaviour is the point, and it must arm whether
+            # or not the abort hook then turns this into a restart
+            try:
+                self.capture_hook(name, fields.get("step", -1))
+            except Exception:  # noqa: BLE001 — observability never takes
+                pass           # the run down
         if self.abort:
             raise AnomalyAbort(
                 f"anomaly watchdog: {name} "
@@ -80,6 +148,17 @@ class AnomalyWatchdog:
         step_time_spike (the stall's shadow would otherwise fire first
         under abort=True and misname the cause)."""
         busy_s = max(0.0, step_s - (data_wait_s or 0.0))
+        if data_wait_s is not None and self.stall_abs_s is not None \
+                and data_wait_s > self.stall_abs_s:
+            # the unconditional absolute bound: no warm-up, no median —
+            # samples still recorded first so a replayed step re-enters
+            # a warmed-up detector (the relative path's convention)
+            self._step_s.append(busy_s)
+            self._wait_s.append(data_wait_s)
+            self._fire("loader_stall", step=step,
+                       data_wait_s=round(data_wait_s, 4),
+                       absolute_bound_s=self.stall_abs_s)
+            return
         if data_wait_s is not None and len(self._wait_s) >= self.min_samples:
             med_w = statistics.median(self._wait_s)
             if data_wait_s > self.stall_min_s and \

@@ -1446,7 +1446,13 @@ class Trainer:
             if fault_hook is not None:
                 fault_hook(i)
             if step_hook is not None:
-                step_hook(i)
+                # the GLOBAL step label (start_step + i) — the same
+                # numbering the spans, the watchdog, and the straggler
+                # table use, so an armed capture window's step range can
+                # be lined up against a flagged step on a mid-epoch
+                # resume (the profiler's static window triggers on its
+                # own call count, not this label)
+                step_hook(start_step + i)
             t_disp = time.perf_counter()
             state, metrics = self._train_step(state, batch, epoch_key)
             dispatch_s = time.perf_counter() - t_disp

@@ -53,6 +53,11 @@ _FILE_BUDGETS_S = {
     # real mock-step loop leg + HTTP scrapes with sub-second sleeps —
     # cheap today, but endpoint tests accrete timeouts easily.
     "test_telemetry_fleet.py": 90.0,   # measured ~3 s fast
+    # The device-time attribution suite (ISSUE 15): real jax.profiler
+    # captures through the instrumented loop + HTTP endpoints — trace
+    # capture/parse cost accretes per leg, so new windows name
+    # themselves here.
+    "test_device_profile.py": 120.0,   # measured ~7 s fast
 }
 _file_seconds: dict = {}
 

@@ -370,6 +370,60 @@ class TestPallasCallInOpsOnly:
 
 
 # ---------------------------------------------------------------------------
+# profiler-session-via-stepprofiler-only
+# ---------------------------------------------------------------------------
+
+
+class TestProfilerSessionHome:
+    RULE = ["profiler-session-via-stepprofiler-only"]
+
+    def test_mutation_every_use_form_flags(self, tmp_path):
+        for src in (
+            "import jax\njax.profiler.start_trace('/tmp/t')\n",
+            "import jax\njax.profiler.stop_trace()\n",
+            "import jax\nst = jax.profiler.start_trace\nst('/tmp/t')\n",
+            "from jax.profiler import start_trace\nstart_trace('/tmp/t')\n",
+            "from jax.profiler import stop_trace as halt\nhalt()\n",
+        ):
+            findings = _lint(tmp_path, src, rules=self.RULE)
+            assert findings, f"did not flag: {src!r}"
+            assert _rules_of(findings) == set(self.RULE)
+
+    def test_profiling_home_is_exempt(self, tmp_path):
+        src = ("import jax\n\ndef open_session(d):\n"
+               "    jax.profiler.start_trace(d)\n")
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="utils/profiling.py") == []
+        # exact path-component match: lookalikes must not inherit it
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="myutils/profiling.py") != []
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="utils/my_profiling.py") != []
+
+    def test_docstring_mentions_and_other_profiler_api_clean(self,
+                                                             tmp_path):
+        src = '''
+            """Docs may say jax.profiler.start_trace freely."""
+            import jax
+
+            def annotate(name):
+                # other jax.profiler API is not a session entry point
+                return jax.profiler.TraceAnnotation(name)
+        '''
+        assert _lint(tmp_path, src, rules=self.RULE) == []
+        suppressed = (
+            "import jax\njax.profiler.start_trace('/t')  "
+            "# analysis: disable=profiler-session-via-stepprofiler-only\n")
+        assert _lint(tmp_path, suppressed, rules=self.RULE) == []
+
+    def test_repo_profiling_is_the_only_user(self):
+        """The rule binds on the real tree: every raw session entry in
+        the repo lives in utils/profiling.py (trace_analysis's
+        capture_step_trace migrated onto trace_session)."""
+        assert run_ast_rules(rules=self.RULE) == []
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics
 # ---------------------------------------------------------------------------
 

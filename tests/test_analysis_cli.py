@@ -42,6 +42,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "tp-psum-signature" in kinds
     assert "fsdp-gather-rides-data-only" in kinds
     assert "span-names-registered" in kinds
+    assert "profiler-session-via-stepprofiler-only" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

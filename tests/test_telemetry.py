@@ -537,7 +537,11 @@ class TestInstrumentedLoop:
         carries the FULL new surface — a fleet-stamped per-rank recorder
         (gen/rank on every event), the all-ranks opt-in armed, AND a live
         /metrics server observing the stream — and the HLO still cannot
-        tell."""
+        tell. Extended for ISSUE 15: the ON side additionally lowers
+        with the whole profiling surface armed — a re-armable
+        StepProfiler wired as the server's POST /profile handler, the
+        watchdog's anomaly capture hook installed, and an on-demand
+        jax.profiler capture session OPEN while lowering runs."""
         trainer, state_factory, loader = tiny_rig
         state = state_factory()
         batch = next(iter(loader.epoch(0)))
@@ -552,9 +556,23 @@ class TestInstrumentedLoop:
         assert (rec.gen, rec.rank) == (3, 1)
         server = telemetry.MetricsServer(0, recorder=rec)  # ephemeral
         server.start()
-        trainer.watchdog = telemetry.AnomalyWatchdog()
+        from distributed_pytorch_training_tpu.telemetry import (
+            device as tele_device,
+        )
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
+        profiler = StepProfiler(str(tmp_path / "prof"),
+                                on_capture=tele_device.make_ingestor())
+        server.profile_handler = profiler.request_capture
+        trainer.watchdog = telemetry.AnomalyWatchdog(
+            capture_hook=lambda name, step: profiler.request_capture(
+                2, reason=f"anomaly:{name}", trigger_step=step))
         try:
-            on = trainer._train_step.lower(state, batch, key).as_text()
+            with profiler.capture(reason="hlo-pin") as trace_dir:
+                assert trace_dir is not None
+                on = trainer._train_step.lower(state, batch,
+                                               key).as_text()
         finally:
             trainer.watchdog = None
             server.stop()

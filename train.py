@@ -189,8 +189,9 @@ def _run(args, guard):
     if metrics_port and telemetry.is_configured():
         # a bind failure returns None (stderr-noted) instead of raising:
         # the live surface must never take the training run down
-        if telemetry.start_metrics_server(metrics_port,
-                                          telemetry.get()) is not None:
+        if telemetry.start_metrics_server(
+                metrics_port, telemetry.get(),
+                backend=jax.default_backend()) is not None:
             log_main(f"Telemetry: serving /metrics + /healthz on "
                      f":{metrics_port}")
     # Relay-tunnel deathwatch (resilience/heartbeat.py, the layer bench.py
@@ -555,9 +556,15 @@ def _run(args, guard):
     if not args.no_telemetry:
         # anomaly watchdog fed by train_epoch's host-side timings + the
         # print-boundary losses; abort hook off unless asked (with
-        # --max-restarts an abort is a restartable failure: restore+replay)
+        # --max-restarts an abort is a restartable failure: restore+replay).
+        # Detector knobs honor DPT_WATCHDOG_* env overrides — how an
+        # orchestrator tunes warm-up/floors on children it cannot pass
+        # flags to (the fleet's anomaly-capture story on short runs).
+        from distributed_pytorch_training_tpu.telemetry.watchdog import (
+            kwargs_from_env,
+        )
         trainer.watchdog = telemetry.AnomalyWatchdog(
-            abort=args.telemetry_abort)
+            abort=args.telemetry_abort, **kwargs_from_env())
 
     state = trainer.init_state(model, sample_input, tx,
                                jax.random.PRNGKey(args.seed))
@@ -780,12 +787,57 @@ def _run(args, guard):
             sys.exit(DEATHWATCH_EXIT_CODE)
         return
 
+    # The device-time attribution plane (ISSUE 15): a re-armable
+    # StepProfiler exists whenever --profile-dir names a static window OR
+    # the live /metrics surface is up (captures then land under
+    # <output-dir>/profiles). Armed three ways: the static
+    # --profile-steps window, POST /profile?steps=K on the metrics port,
+    # and the watchdog's anomaly capture hook (a step-time spike /
+    # loader stall records its own trace while it happens). Every closed
+    # window is ingested by telemetry/device.py into a typed
+    # device_profile event — per-phase device ms, per-collective rollup,
+    # exposed-comm ratio, measured MFU. With both surfaces off, no
+    # profiler object exists and the loop's step_hook stays None — the
+    # zero-per-step-cost contract (pinned by test) is structural.
     profiler = None
-    if args.profile_dir:
-        from distributed_pytorch_training_tpu.utils.profiling import StepProfiler
+    profile_base = args.profile_dir
+    if profile_base is None and metrics_port and telemetry.is_configured():
+        profile_base = str(Path(args.output_dir) / "profiles")
+    if profile_base is not None:
+        from distributed_pytorch_training_tpu.telemetry import (
+            device as tele_device,
+        )
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler,
+        )
 
-        start, stop = (int(x) for x in args.profile_steps.split(","))
-        profiler = StepProfiler(args.profile_dir, start, stop)
+        start = stop = None
+        if args.profile_dir:
+            start, stop = (int(x) for x in args.profile_steps.split(","))
+
+        def _mfu_ref():
+            # lazily read: set_mfu_reference runs after this closure is
+            # built, and only on backends with a known chip peak
+            if trainer._flops_per_sample and trainer._peak_flops_total:
+                return (trainer._flops_per_sample * global_batch,
+                        trainer._peak_flops_total)
+            return None
+
+        profiler = StepProfiler(
+            profile_base, start, stop,
+            on_capture=tele_device.make_ingestor(mfu_ref=_mfu_ref))
+        server = (telemetry.get_metrics_server()
+                  if metrics_port and telemetry.is_configured() else None)
+        if server is not None:
+            server.profile_handler = profiler.request_capture
+        if trainer.watchdog is not None:
+            trainer.watchdog.capture_hook = (
+                lambda name, step: profiler.request_capture(
+                    2, reason=f"anomaly:{name}", trigger_step=step))
+        log_main(f"Profiler: on-demand capture armed (traces under "
+                 f"{profile_base}"
+                 + (f"; static window steps {start}-{stop}"
+                    if start is not None else "") + ")")
 
     # Context-managed: an exception (or preemption-path raise) mid-epoch
     # must still stop an open jax.profiler session — a leaked session
