@@ -136,6 +136,25 @@ EXTRA_CONFIGS = (
     ("gpt2_124m_gsync_mh", "gpt2_124m", 400,
      dict(per_device_batch=8, seq_len=1024, steps=10,
           grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_multihop"))),
+    # Two-tier topology-aware wire (wire_dtype="int8_hier"): exact fp32
+    # reduce-scatter INSIDE a slice (fast ICI tier), the s8+EF multihop
+    # exchange ACROSS slices (slow DCN tier — ~2 B/element per slice
+    # independent of the slice count), exact intra-slice all-gather back.
+    # The mesh_spec carries the slice factorization; needs >= 2 chips
+    # (slice=2 on one device fails the mesh build loudly and the
+    # per-config guard records the skip, like the _tp arm) — on a
+    # slice-axis-of-1 mesh the trainer instead resolves to the flat fp32
+    # passthrough (bit-identical). Rows record wire_bytes_per_replica
+    # with the slow-tier term split out so the slice-count-independence
+    # claim is a committed number.
+    ("resnet18_gsync_hier", "resnet18", 420,
+     dict(per_device_batch=4096, image_hw=32, num_classes=10, steps=20,
+          grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_hier"),
+          mesh_spec="slice=2,data=-1")),
+    ("gpt2_124m_gsync_hier", "gpt2_124m", 400,
+     dict(per_device_batch=8, seq_len=1024, steps=10,
+          grad_sync=dict(bucket_cap_mb=25.0, wire_dtype="int8_hier"),
+          mesh_spec="slice=2,data=-1")),
     # Explicit full-parameter FSDP (training/loop.py fsdp_explicit;
     # SimpleFSDP, PAPERS.md): params + moments flat-sharded 1/N at rest,
     # one just-in-time param all-gather per layer group, gradients

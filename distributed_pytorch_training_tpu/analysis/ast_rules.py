@@ -36,7 +36,8 @@ PKG_ROOT = Path(__file__).resolve().parent.parent
 SHARD_MAP_SHIM = "parallel/collectives.py"
 
 # Mirror of parallel/mesh.py AXIS_NAMES (kept import-free; test-pinned).
-AXIS_NAMES = frozenset({"data", "fsdp", "model", "seq", "pipe", "expert"})
+AXIS_NAMES = frozenset({"data", "fsdp", "model", "seq", "pipe", "expert",
+                        "slice"})
 
 # Collective-call names whose axis argument must come from the registry.
 _AXIS_CALLS = frozenset({

@@ -23,17 +23,24 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-# Wire modes the contracts understand — all four are implemented
+# Wire modes the contracts understand — all five are implemented
 # (parallel/grad_sync.py WIRE_DTYPES). "int8_multihop" is the DynamiQ-style
 # s8 reduce-scatter + requantize + s8 all-gather form: it legitimately
 # spends TWO collectives per bucket, so the census bound is parameterized
 # by mode instead of hard-coding 1 — the mode landed with no checker
 # relaxation, exactly as this comment promised when it was a ROADMAP item.
-WIRE_MODES = ("fp32", "bf16", "int8", "int8_multihop")
+# "int8_hier" is the two-tier topology-aware form (ISSUE 16): exact fp32
+# reduce-scatter + all-gather inside the slice, the s8 multihop pair across
+# slices — 4 gradient-sized collectives per bucket, classified per tier by
+# the hier-tier-signature rule.
+WIRE_MODES = ("fp32", "bf16", "int8", "int8_multihop", "int8_hier")
 
 # HLO dtype each wire mode promises on gradient-sized collective operands.
+# For "int8_hier" this is the SLOW-TIER promise: cross-slice gradient
+# collectives ride s8; the intra-slice pair is exempt (exact fp32 by
+# design — no-fp32-wire filters by tier).
 WIRE_HLO_DTYPE = {"fp32": "f32", "bf16": "bf16", "int8": "s8",
-                  "int8_multihop": "s8"}
+                  "int8_multihop": "s8", "int8_hier": "s8"}
 
 
 def collectives_per_bucket(wire_mode: str) -> int:
@@ -43,12 +50,14 @@ def collectives_per_bucket(wire_mode: str) -> int:
     gather). The multi-hop int8 form reduces in two hops (s8 all-to-all
     reduce-scatter, requantized s8 all-gather), so its census bound is 2
     per bucket — the contract knows the mode, the bound is never hand-
-    relaxed.
+    relaxed. The hierarchical form spends 4: the exact intra-slice
+    reduce-scatter and all-gather bracket the cross-slice s8 pair
+    (grad_sync._int8_hier_sum).
     """
     if wire_mode not in WIRE_MODES:
         raise ValueError(f"unknown wire mode {wire_mode!r} "
                          f"(choose from {WIRE_MODES})")
-    return 2 if wire_mode == "int8_multihop" else 1
+    return {"int8_multihop": 2, "int8_hier": 4}.get(wire_mode, 1)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,6 +205,31 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "multi-hop int8 reducer with in-scan overlapped accumulation",
              config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_multihop",
                          grad_accum=2), min_shards=2),
+    # Two-tier topology-aware wire (ISSUE 16) on the (slice=2, data=4)
+    # factored CPU mesh: per bucket, an exact fp32 intra-slice
+    # reduce-scatter, the s8 multihop pair across slices (the ONLY
+    # compressed tier — EF lives there), and an exact fp32 intra-slice
+    # all-gather. The hier-tier-signature rule classifies every gradient
+    # collective's replica groups by tier (the PR-12 axis classifier,
+    # generalized) and pins the per-tier signature; no-fp32-wire exempts
+    # only the intra-slice (ici) tier.
+    Contract("gsync_int8_hier",
+             "bucketed reducer, two-tier hier wire: exact fp32 ICI "
+             "reduce-scatter/all-gather inside the slice, s8 multihop "
+             "pair across slices (4/bucket, per-tier classified)",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_hier"),
+             min_shards=2, mesh_spec="slice=2,data=4"),
+    Contract("gsync_int8_hier_accum",
+             "two-tier hier reducer with in-scan overlapped accumulation",
+             config=dict(bucket_cap_mb=_CAP, wire_dtype="int8_hier",
+                         grad_accum=2), min_shards=2,
+             mesh_spec="slice=2,data=4"),
+    Contract("zero1_int8_hier",
+             "zero1 with the two-tier wire: hier scatter (exact fast "
+             "reduce-scatter + s8 cross-slice exchange w/ EF) and the s8 "
+             "cross-slice + exact intra-slice param delta gather",
+             config=dict(zero1=True, wire_dtype="int8_hier"),
+             min_shards=2, mesh_spec="slice=2,data=4"),
     Contract("gsync_int8_mh_fused",
              "multi-hop int8 wire with the fused Pallas codec kernels "
              "(ops/quantize.py; interpreter mode on the CPU matrix — the "
@@ -226,23 +260,29 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              min_shards=2),
     # Explicit TP x FSDP on the 2-D ("data","model") mesh (ISSUE 13): the
     # tp-psum-signature budget binds (one megatron psum per residual join
-    # + backward mirrors + the vocab-parallel embedding pair, one logits
-    # gather), every param gather/scatter rides the data axes only
+    # + backward mirrors + the vocab-parallel embedding pair + the
+    # parallel-vocab CE's two stat psums, ZERO model-axis gathers —
+    # ISSUE 16 replaced the vocab-scale logits gather), every param
+    # gather/scatter rides the data axes only
     # (fsdp-gather-rides-data-only), the per-layer gather/scatter census
     # holds over the TP-LOCAL layer plan, and no gradient-sized all-reduce
     # survives off the model axis. No existing rule is relaxed: 1-D
-    # artifacts never consult the axis classifier.
+    # artifacts never consult the axis classifier. min_elements=64 (not
+    # the default 128): the CE stats are (rows, seq-1, 2)-shaped — 120
+    # elements at the tiny contract batch — and the gather-regression pin
+    # is only as strong as the floor that lets the census SEE the head's
+    # collectives.
     Contract("fsdp_tp",
              "explicit megatron TP x FSDP on data=4,model=2: model-axis "
              "psum budget + data-axis-only param wire, exact fp32",
              config=dict(fsdp_explicit=True), min_shards=2,
-             mesh_spec="data=4,model=2"),
+             min_elements=64, mesh_spec="data=4,model=2"),
     Contract("fsdp_tp_int8_mh",
              "explicit TP x FSDP fully compressed: s8 data-axis gradient "
              "scatter (EF per model shard) + s8 data-axis param gathers; "
              "model-axis activation psums stay exact fp32 by design",
              config=dict(fsdp_explicit=True, wire_dtype="int8_multihop"),
-             min_shards=2, mesh_spec="data=4,model=2"),
+             min_shards=2, min_elements=64, mesh_spec="data=4,model=2"),
     # The serving decode-step contract (ISSUE 10): the inference engine's
     # one-token KV-cache step must carry NO host transfers (a callback in
     # the decode loop stalls every generated token) and must DONATE the

@@ -167,6 +167,23 @@ def replica_group_axis(groups: str, n_batch: int, n_model: int) -> str:
     return "other"
 
 
+def replica_group_tier(groups: str, n_slices: int, n_inner: int) -> str:
+    """Which TIER a collective's replica groups ride on the two-tier
+    (slice x intra-slice) layout: "ici" (groups stay inside one slice —
+    the fast interconnect), "dcn" (groups cross slices — the slow
+    inter-slice links), "all" (one group spanning the mesh), or
+    "other"/"unknown". The slice axis is OUTERMOST in AXIS_ORDER
+    (parallel/mesh.py), so device ids are slice-major: intra-slice groups
+    are consecutive-id runs of size n_inner and cross-slice groups are
+    stride-n_inner combs — exactly the geometry `replica_group_axis`
+    already classifies with (n_batch, n_model) = (n_slices, n_inner);
+    this wrapper renames its verdicts into tier vocabulary. With
+    n_inner=1 (no intra-slice width) every hier collective spans all
+    slices and classifies "dcn" — there is no fast tier to ride."""
+    axis = replica_group_axis(groups, max(n_slices, 1), max(n_inner, 1))
+    return {"model": "ici", "data": "dcn"}.get(axis, axis)
+
+
 def weight_update_census(compiled_text: str, min_elements: int = 8192) -> dict:
     """The gradient-sync subset of the census: collectives whose result
     carries at least `min_elements` elements — gradient- and parameter-sized
@@ -301,6 +318,20 @@ class StepArtifacts:
     model_shards: int = 1
     tp_expected_psums: int = 0
     tp_expected_model_gathers: int = 0
+    # Per-shard element count of EACH of the parallel-vocab CE's two
+    # model-axis stat collectives (both (rows, seq-1, 2)-shaped by
+    # construction — collectives.tp_parallel_cross_entropy). Batch-shaped,
+    # so unlike the hidden-sized structural psums their census visibility
+    # depends on batch x floor: `tp-psum-signature` adds 2 to the psum
+    # budget iff this clears min_elements. Snapshotted from
+    # Trainer.tp_expected_ce_stat_elements; 0 when the vocab-parallel
+    # head is not engaged.
+    tp_ce_stat_elements: int = 0
+    # Two-tier hierarchical sync (int8_hier): the mesh's slice-axis size
+    # (1 = single-slice — every pre-existing artifact). Snapshotted from
+    # the trainer's resolved HierSpec, never re-derived in a rule: the
+    # tier classification of every hier census row keys on it.
+    slice_shards: int = 1
 
     @property
     def wire_mode(self) -> str:
@@ -317,6 +348,23 @@ class StepArtifacts:
         return replica_group_axis(row.get("replica_groups", ""),
                                   max(self.n_shards, 1),
                                   max(self.model_shards, 1))
+
+    @property
+    def hier_engaged(self) -> bool:
+        """Mirrors Trainer's engagement condition for the two-tier wire:
+        int8_hier on a mesh with a real slice axis (on slices=1 the
+        trainer resolves to the flat fp32 path BEFORE tracing, so no hier
+        collective exists to classify)."""
+        return (self.wire_mode == "int8_hier" and self.slice_shards > 1
+                and self.n_shards > 1)
+
+    def collective_tier(self, row: dict) -> str:
+        """`replica_group_tier` of one census row under this artifact's
+        (slice, intra-slice) factorization: n_inner is the intra-slice
+        batch-shard count n_shards / slice_shards."""
+        n_slices = max(self.slice_shards, 1)
+        return replica_group_tier(row.get("replica_groups", ""), n_slices,
+                                  max(self.n_shards // n_slices, 1))
 
     @property
     def zero1_engaged(self) -> bool:
@@ -419,6 +467,10 @@ def check_compressed_wire(a: StepArtifacts) -> List[Finding]:
     if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged
                                      or a.fsdp_engaged):
         return []
+    if a.wire_mode == "int8_hier" and not a.hier_engaged:
+        # slices=1 passthrough: the trainer resolved int8_hier to the flat
+        # fp32 path before tracing — there is no s8 wire to demand
+        return []
     if a.preopt_text is None:
         # No reliable wire read: CPU's float-normalization promotes bf16
         # collectives to f32 in the OPTIMIZED text, so checking it would
@@ -446,6 +498,8 @@ def check_no_fp32_wire(a: StepArtifacts) -> List[Finding]:
     if a.wire_mode == "fp32" or not (a.grad_sync_engaged or a.zero1_engaged
                                      or a.fsdp_engaged):
         return []
+    if a.wire_mode == "int8_hier" and not a.hier_engaged:
+        return []  # slices=1 passthrough — see check_compressed_wire
     if a.preopt_text is None:
         return []  # no reliable wire read — see check_compressed_wire
     census = grad_sync_census(a.wire_text, a.min_elements)
@@ -456,6 +510,13 @@ def check_no_fp32_wire(a: StepArtifacts) -> List[Finding]:
     rows = census["rows"]
     if a.tp_engaged:
         rows = [r for r in rows if a.collective_axis(r) != "model"]
+    if a.hier_engaged:
+        # Two-tier wire: the INTRA-slice stage reduces in exact fp32 BY
+        # DESIGN (that tier rides the fast interconnect; s8 is the
+        # SLOW-tier promise — contracts.WIRE_HLO_DTYPE). Only the ici
+        # tier is exempt: cross-slice rows (and anything the classifier
+        # can't place) must still keep every gradient byte compressed.
+        rows = [r for r in rows if a.collective_tier(r) != "ici"]
     bad = [r for r in rows
            if r["op"] in _REDUCTION_KINDS and "f32" in r["dtypes"]]
     if bad:
@@ -465,6 +526,111 @@ def check_no_fp32_wire(a: StepArtifacts) -> List[Finding]:
             f"reducing collective(s) carry f32 operands: "
             f"{[(r['op'], r['result_shape']) for r in bad]}", a.name)]
     return []
+
+
+@rule("hier-tier-signature", "hlo",
+      "the two-tier wire rides each tier with the right signature: exact "
+      "reduce-scatter/all-gather INSIDE a slice, an s8 scatter+gather "
+      "hop pair ACROSS slices, nothing spanning both",
+      "the 4/bucket budget alone is a ceiling a flat codec sails under — "
+      "the TIER-classified signature is what pins the hierarchy: a flat "
+      "multihop mislabeled int8_hier shows no cross-slice-only hop (its "
+      "groups span the whole mesh), a hierarchy that lost its fast stage "
+      "shows no intra-slice reduce-scatter, and an fp32 byte on a "
+      "cross-slice collective is paying exact-width traffic on the slow "
+      "links the mode exists to compress (parallel/grad_sync.py "
+      "_int8_hier_sum; slice-major device ids make the tiers readable "
+      "straight off replica_groups — parallel/mesh.py AXIS_ORDER).")
+def check_hier_tier_signature(a: StepArtifacts) -> List[Finding]:
+    if not a.hier_engaged or not (a.grad_sync_engaged or a.zero1_engaged
+                                  or a.fsdp_engaged):
+        return []
+    n_slices = max(a.slice_shards, 1)
+    n_inner = max(a.n_shards // n_slices, 1)
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    by_tier_op: Dict[Tuple[str, str], int] = {}
+    for r in census["rows"]:
+        key = (a.collective_tier(r), r["op"])
+        by_tier_op[key] = by_tier_op.get(key, 0) + r["count"]
+
+    def n(tier: str, *ops: str) -> int:
+        return sum(by_tier_op.get((tier, op), 0) for op in ops)
+
+    out = []
+    spanning = [(t, op, c) for (t, op), c in sorted(by_tier_op.items())
+                if t not in ("ici", "dcn")]
+    if spanning:
+        out.append(Finding(
+            "hier-tier-signature",
+            f"{sum(c for _, _, c in spanning)} gradient-sized "
+            f"collective(s) ride groups that are neither intra-slice nor "
+            f"cross-slice: {spanning[:5]} — a hier collective grouped "
+            "over the whole mesh (or off-pattern) is flat traffic wearing "
+            "the two-tier flag", a.name))
+    dcn_scatter = n("dcn", "all-to-all", "reduce-scatter")
+    dcn_gather = n("dcn", "all-gather")
+    if not dcn_scatter:
+        out.append(Finding(
+            "hier-tier-signature",
+            "no gradient-sized CROSS-SLICE all-to-all/reduce-scatter — "
+            "hop 1 of the slow-tier s8 exchange is missing", a.name))
+    if not dcn_gather:
+        out.append(Finding(
+            "hier-tier-signature",
+            "no gradient-sized CROSS-SLICE all-gather — hop 2 (the "
+            "requantized s8 gather) is missing", a.name))
+    if n_inner > 1:
+        if not n("ici", "reduce-scatter"):
+            out.append(Finding(
+                "hier-tier-signature",
+                "no gradient-sized INTRA-SLICE reduce-scatter — the "
+                "exact fast-tier reduce is missing (every byte is riding "
+                "the slow links)", a.name))
+        if not n("ici", "all-gather"):
+            out.append(Finding(
+                "hier-tier-signature",
+                "no gradient-sized INTRA-SLICE all-gather — the reduced "
+                "buckets are never rebuilt across the slice", a.name))
+    if a.grad_sync_engaged and a.total_grad_bytes:
+        # The bucketed-reducer arm pins EXACT per-bucket counts per tier
+        # (zero1/fsdp cut per shard-group/layer instead — presence-only
+        # above). Every hop's census result clears the floor whenever the
+        # smallest (the 1/n_inner slow-tier part) does, so one floor
+        # check guards the whole expectation from tiny-bucket noise.
+        n_buckets = expected_buckets(
+            a.total_grad_bytes, float(a.config.get("bucket_cap_mb", 0.0)))
+        part = (a.total_grad_bytes // 4) // max(n_buckets, 1) // n_inner
+        if part >= a.min_elements:
+            expect = [(dcn_scatter, "cross-slice scatter (hop 1)"),
+                      (dcn_gather, "cross-slice all-gather (hop 2)")]
+            if n_inner > 1:
+                expect += [(n("ici", "reduce-scatter"),
+                            "intra-slice reduce-scatter"),
+                           (n("ici", "all-gather"), "intra-slice all-gather")]
+            for got, label in expect:
+                if got != n_buckets:
+                    out.append(Finding(
+                        "hier-tier-signature",
+                        f"step carries {got} {label} collective(s), "
+                        f"expected exactly {n_buckets} (one per bucket; "
+                        f"census by (tier, op): "
+                        f"{dict(sorted(by_tier_op.items()))})", a.name))
+    if a.preopt_text is not None:
+        # the dtype read (pre-opt text — see check_compressed_wire): no
+        # fp32 byte may CROSS slices, on any collective kind. Stricter
+        # than no-fp32-wire, which exempts gathers mode-wide: the hier
+        # slow-tier gather is s8 by construction, so fp32 there is a
+        # decompressed hop-2 paying 4x on the slow links.
+        wrows = grad_sync_census(a.wire_text, a.min_elements)["rows"]
+        bad = [(r["op"], r["result_shape"]) for r in wrows
+               if a.collective_tier(r) == "dcn" and "f32" in r["dtypes"]]
+        if bad:
+            out.append(Finding(
+                "hier-tier-signature",
+                f"{len(bad)} CROSS-SLICE collective(s) carry f32 "
+                f"operands: {bad[:5]} — the slow tier must ride s8 codes "
+                "(+ sub-floor scale rows) only", a.name))
+    return out
 
 
 @rule("zero1-collectives", "hlo",
@@ -626,14 +792,19 @@ def check_fsdp_scatter_signature(a: StepArtifacts) -> List[Finding]:
 
 @rule("tp-psum-signature", "hlo",
       "explicit TP carries exactly the megatron model-axis collective "
-      "budget: one psum per residual join (+ backward mirror), one "
-      "vocab-parallel logits gather",
+      "budget: one psum per residual join (+ backward mirror), the "
+      "parallel-vocab CE's two stat collectives, and ZERO model-axis "
+      "gathers",
       "the model-axis psums ARE the TP wire: fewer than the budget means "
       "a parallel region lost its f/g operator (silently wrong gradients "
       "or a dead region); more means extra model-axis traffic smuggled "
-      "into every step. The budget comes from the trainer's TP model "
-      "(4/block + 2 with the vocab-parallel embedding), never hard-coded "
-      "(parallel/collectives.py copy_to_tp / reduce_from_tp; ISSUE 13).")
+      "into every step — and ANY model-axis all-gather means the "
+      "vocab-scale logits gather the parallel-vocab cross-entropy "
+      "removed crept back. The budget comes from the trainer's TP model "
+      "(4/block + 2 with the vocab-parallel embedding; the batch-shaped "
+      "CE stats counted iff they clear the census floor), never "
+      "hard-coded (parallel/collectives.py copy_to_tp / reduce_from_tp / "
+      "tp_parallel_cross_entropy; ISSUEs 13 + 16).")
 def check_tp_psum_signature(a: StepArtifacts) -> List[Finding]:
     if not a.tp_engaged:
         return []
@@ -650,21 +821,29 @@ def check_tp_psum_signature(a: StepArtifacts) -> List[Finding]:
     gathers = sum(r["count"] for r in census["rows"]
                   if r["op"] == "all-gather"
                   and a.collective_axis(r) == "model")
+    # the CE stats (pmax + stacked psum, one shared size class) are
+    # visible only when their batch-shaped operands clear the floor
+    ce_visible = 2 if a.tp_ce_stat_elements >= a.min_elements else 0
+    expected_psums = a.tp_expected_psums + ce_visible
     out = []
-    if psums != a.tp_expected_psums:
+    if psums != expected_psums:
         out.append(Finding(
             "tp-psum-signature",
-            f"step carries {psums} hidden-sized model-axis all-reduce(s), "
-            f"expected exactly {a.tp_expected_psums} (one per residual "
-            "join forward + its backward mirror per parallel region"
-            + (", +2 for the vocab-parallel embedding"
-               if a.tp_expected_model_gathers else "") + ")", a.name))
+            f"step carries {psums} model-axis all-reduce(s), expected "
+            f"exactly {expected_psums} ({a.tp_expected_psums} structural: "
+            "one per residual join forward + its backward mirror per "
+            "parallel region, +2 for the vocab-parallel embedding when "
+            f"engaged; +{ce_visible} parallel-vocab CE stats at "
+            f"{a.tp_ce_stat_elements} elements vs floor "
+            f"{a.min_elements})", a.name))
     if gathers != a.tp_expected_model_gathers:
         out.append(Finding(
             "tp-psum-signature",
             f"step carries {gathers} model-axis all-gather(s), expected "
-            f"exactly {a.tp_expected_model_gathers} (the vocab-parallel "
-            "logits gather when the embedding is TP-split)", a.name))
+            f"exactly {a.tp_expected_model_gathers} — the parallel-vocab "
+            "cross-entropy computes the loss from local logit columns; "
+            "a vocab-scale model-axis gather is the regression it "
+            "replaced", a.name))
     return out
 
 
@@ -795,10 +974,12 @@ _QUANTIZE_KERNEL_NAMES = ("fused_quantize_int8_rows",
       "the config claims the kernel path — the same silent-fallback class "
       "compressed-wire guards for the wire dtype (ops/quantize.py).")
 def check_fused_quantize_kernel(a: StepArtifacts) -> List[Finding]:
-    if a.wire_mode not in ("int8", "int8_multihop"):
+    if a.wire_mode not in ("int8", "int8_multihop", "int8_hier"):
         return []  # no int8 codec in the step — nothing to fuse
     if not (a.grad_sync_engaged or a.zero1_engaged or a.fsdp_engaged):
         return []  # passthrough config: the codec never runs
+    if a.wire_mode == "int8_hier" and not a.hier_engaged:
+        return []  # slices=1 passthrough — see check_compressed_wire
     fused = a.config.get("fused_quantize")
     if fused is None and a.backend == "tpu":
         # auto (the production default): resolve the tri-state exactly the
@@ -1247,6 +1428,12 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         model_shards=trainer._tp_n,
         tp_expected_psums=tp_psums,
         tp_expected_model_gathers=tp_gathers,
+        # _tiny_lm_setup batches 2 rows per device over n_shards shards,
+        # seq 16 — the same shapes the lowering above traced
+        tp_ce_stat_elements=trainer.tp_expected_ce_stat_elements(
+            2 * mesh.size // max(n_shards, 1), 16),
+        slice_shards=(trainer._hier.n_slices if trainer._hier is not None
+                      else 1),
     )
 
 

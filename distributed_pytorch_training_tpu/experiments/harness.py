@@ -32,12 +32,16 @@ import numpy as np
 def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
                         model_name: str = "resnet18", image_hw: int = 32,
                         num_classes: int = 10, zero1: bool = False,
-                        grad_sync: Optional[dict] = None):
+                        grad_sync: Optional[dict] = None,
+                        mesh_spec: Optional[str] = None):
     """(trainer, state, mesh) for an image-classification config on a pure-DP
     mesh over `devices` (the benchmark workload, BASELINE.json:8).
     ``zero1`` switches the trainer to the sharded weight update;
     ``grad_sync`` holds TrainConfig overrides for the explicit reducer
-    (bucket_cap_mb / wire_dtype / overlap_grad_sync / grad_accum)."""
+    (bucket_cap_mb / wire_dtype / overlap_grad_sync / grad_accum).
+    ``mesh_spec`` may name BATCH axes only ("slice=2,data=-1", the
+    int8_hier tiered-wire arms) — image models ship replicated-only
+    partition rules, so a model/seq axis is rejected upstream."""
     from ..data import CIFAR10_MEAN, CIFAR10_STD
     from ..models import get_model
     from ..parallel import MeshSpec, build_mesh
@@ -45,7 +49,9 @@ def build_image_trainer(devices: Sequence[jax.Device], bf16: bool,
     from ..training.optim import sgd
     from ..training.tasks import ImageClassificationTask
 
-    mesh = build_mesh(MeshSpec(data=len(devices)), devices=list(devices))
+    spec = (MeshSpec.parse(mesh_spec) if mesh_spec
+            else MeshSpec(data=len(devices)))
+    mesh = build_mesh(spec, devices=list(devices))
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     model = get_model(model_name, num_classes=num_classes, dtype=dtype)
     task = ImageClassificationTask(mean=CIFAR10_MEAN, std=CIFAR10_STD,
@@ -186,12 +192,23 @@ def build_trainer(devices: Sequence[jax.Device], bf16: bool, model_name: str,
                                 lm_overrides, zero1=zero1,
                                 grad_sync=grad_sync, mesh_spec=mesh_spec)
     if mesh_spec:
-        raise ValueError(
-            f"mesh_spec={mesh_spec!r} is an LM-arm knob (explicit TP); "
-            f"{model_name} has no TP form")
+        # image models may tier their BATCH axes (slice=2,data=-1 — the
+        # int8_hier arms); any non-batch axis > 1 needs partition rules
+        # image models don't have
+        from ..parallel import MeshSpec
+        from ..parallel.mesh import BATCH_AXES
+
+        sizes = dataclasses.asdict(MeshSpec.parse(mesh_spec))
+        bad = {a: s for a, s in sizes.items()
+               if s not in (1,) and a not in BATCH_AXES}
+        if bad:
+            raise ValueError(
+                f"mesh_spec={mesh_spec!r} puts {bad} on non-batch axes; "
+                f"{model_name} has no TP/seq/pipe form — image models "
+                "accept batch-axis tiers only (slice/data/fsdp)")
     return build_image_trainer(devices, bf16, model_name, image_hw,
                                num_classes, zero1=zero1,
-                               grad_sync=grad_sync)
+                               grad_sync=grad_sync, mesh_spec=mesh_spec)
 
 
 def make_synth_batch(mesh, model_name: str, per_device_batch: int,
@@ -340,7 +357,9 @@ def timed_steps(step_fn: Callable, state, batch, global_batch: int,
 
 
 def _contract_check(trainer, state, optimized_text: str, lowered,
-                    zero1: bool, grad_sync: Optional[dict]) -> Optional[dict]:
+                    zero1: bool, grad_sync: Optional[dict],
+                    per_device_batch: int = 0,
+                    seq_len: int = 0) -> Optional[dict]:
     """Evaluate the HLO contract rules against the measured executable and
     return {"pass": bool, "violations": [...]} for the bench row — the
     per-arm pass/fail bench history tracks across PRs (ISSUE 3).
@@ -386,7 +405,11 @@ def _contract_check(trainer, state, optimized_text: str, lowered,
         artifacts = dataclasses.replace(
             artifacts, model_shards=trainer._tp_n,
             tp_expected_psums=tp_psums,
-            tp_expected_model_gathers=tp_gathers)
+            tp_expected_model_gathers=tp_gathers,
+            tp_ce_stat_elements=trainer.tp_expected_ce_stat_elements(
+                per_device_batch, seq_len),
+            slice_shards=(trainer._hier.n_slices
+                          if trainer._hier is not None else 1))
         findings = check_artifacts(artifacts)
         return {"pass": not findings,
                 "violations": [f.as_dict() for f in findings]}
@@ -728,7 +751,9 @@ def measure_config(model_name: str, per_device_batch: int, steps: int,
         optimized_text = compiled.as_text()
         sync_census = grad_sync_census(optimized_text)
         contracts = _contract_check(trainer, state, optimized_text, lowered,
-                                    zero1=zero1, grad_sync=grad_sync)
+                                    zero1=zero1, grad_sync=grad_sync,
+                                    per_device_batch=per_device_batch,
+                                    seq_len=seq_len)
         # per-replica wire accounting of the configured sync mode (the
         # gather-int8 break-even and the multihop flat ~2 B/element as
         # recorded bench numbers). One call computes the row values AND

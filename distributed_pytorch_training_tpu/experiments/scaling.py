@@ -18,6 +18,10 @@ outline (/root/reference/README.md:27-35):
   XLA path: throughput, the static bucket/wire-dtype census of each
   compiled step, and the trace-derived exposed-comm fraction (overlap
   efficiency) per mode.
+* ``hier``    — two-tier topology-aware sync (wire_dtype="int8_hier") on a
+  slice=2 tiered mesh vs the flat wires: tier-classified collective census
+  + per-tier wire bytes (the slow-tier slice-count-independence claim as
+  recorded numbers).
 * ``gradsync`` — the gradient-synchronization share of step time (the
   README's literal "~X%" placeholder, README.md:35). Three instruments:
   (a) measured: per-device-constant-batch step time on 1 chip vs N chips —
@@ -365,6 +369,81 @@ def run_grad_sync(args) -> List[dict]:
     return rows
 
 
+def run_hier(args) -> List[dict]:
+    """Two-tier topology-aware gradient sync (wire_dtype="int8_hier") vs
+    the flat wires, on the same devices factored into a tiered
+    slice=2 x data=N/2 mesh: per bucket an EXACT fp32 reduce-scatter
+    inside the slice (fast ICI tier), the s8+EF multihop exchange across
+    slices (slow DCN tier), and an exact intra-slice all-gather back.
+
+    Each row carries (a) throughput, (b) the TIER-classified collective
+    census of the compiled step (analysis/hlo_rules.replica_group_tier:
+    intra-slice groups are consecutive-id runs, cross-slice groups are
+    strided combs; "spanning" counts collectives riding the whole mesh —
+    flat traffic that ignores the hierarchy), and (c) the per-replica
+    wire bytes split by tier (`wire_bytes_split_for_config`) — the
+    slow-tier ~2·S/n_inner B/replica (i.e. ~2·S per slice, independent
+    of the slice count) as a RECORDED number next to the flat modes'
+    all-one-tier totals."""
+    from ..analysis.hlo_rules import grad_sync_census, replica_group_tier
+    from ..parallel.grad_sync import wire_bytes_split_for_config
+    from ..parallel.mesh import batch_shard_count
+
+    devices = jax.devices()
+    n = len(devices)
+    if n < 4:
+        return [{"mode": "skipped",
+                 "global_samples_per_s":
+                     "needs >= 4 devices (slice=2 x data>=2)"}]
+    cap = args.bucket_cap_mb
+    mesh_spec = f"slice=2,data={n // 2}"
+    lm_kw = None
+    if args.lm_tiny and is_lm_model(args.model):
+        lm_kw = dict(_LM_TINY)
+        if args.model.startswith("gpt2"):
+            lm_kw.pop("mlp_dim")
+    modes = [("flat_fp32", dict(bucket_cap_mb=cap)),
+             ("flat_int8_multihop",
+              dict(bucket_cap_mb=cap, wire_dtype="int8_multihop")),
+             ("int8_hier", dict(bucket_cap_mb=cap, wire_dtype="int8_hier"))]
+    if args.grad_accum > 1:
+        modes.append(("int8_hier_accum",
+                      dict(bucket_cap_mb=cap, wire_dtype="int8_hier",
+                           grad_accum=args.grad_accum)))
+    rows = []
+    for mode, gs in modes:
+        trainer, state, mesh = build_trainer(
+            devices, args.bf16, args.model, args.seq_len, lm_overrides=lm_kw,
+            grad_sync=gs, mesh_spec=mesh_spec)
+        batch, gb = make_synth_batch(mesh, args.model, args.batch_size,
+                                     args.seq_len)
+        nb = batch_shard_count(mesh)
+        n_slices = dict(mesh.shape).get("slice", 1)
+        compiled = trainer._train_step.lower(
+            state, batch, jax.random.PRNGKey(0)).compile()
+        by_tier: dict = {}
+        for r in grad_sync_census(compiled.as_text())["rows"]:
+            t = replica_group_tier(r["replica_groups"], n_slices,
+                                   nb // n_slices)
+            t = t if t in ("ici", "dcn") else "spanning"
+            by_tier[t] = by_tier.get(t, 0) + r["count"]
+        split = wire_bytes_split_for_config(state.params,
+                                            dict(gs, slices=n_slices), nb)
+        _, sps = timed_steps(compiled, state, batch, gb, args.steps,
+                             repeats=args.repeats,
+                             min_window_s=args.min_window_s)
+        rows.append({
+            "mode": mode,
+            "global_samples_per_s": round(sps, 1),
+            "ici_collectives": by_tier.get("ici", 0),
+            "dcn_collectives": by_tier.get("dcn", 0),
+            "spanning_collectives": by_tier.get("spanning", 0),
+            "wire_bytes_ici": split["ici"],
+            "wire_bytes_dcn": split["dcn"],
+        })
+    return rows
+
+
 def run_fsdp(args) -> List[dict]:
     """Replicated vs explicit full-parameter FSDP on the same devices
     (training/loop.py fsdp_explicit; SimpleFSDP, PAPERS.md): same model,
@@ -607,7 +686,7 @@ def main(argv=None):
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("experiment",
                    choices=["scaling", "batch", "amp", "gradsync",
-                            "grad_sync", "zero1", "fsdp", "tp",
+                            "grad_sync", "hier", "zero1", "fsdp", "tp",
                             "pipeline"])
     p.add_argument("--model", default="resnet18")
     p.add_argument("--batch-size", default=128, type=int,
@@ -642,8 +721,8 @@ def main(argv=None):
 
     fn = {"scaling": run_scaling, "batch": run_batch_sweep, "amp": run_amp,
           "gradsync": run_gradsync, "grad_sync": run_grad_sync,
-          "zero1": run_zero1, "fsdp": run_fsdp, "tp": run_tp,
-          "pipeline": run_pipeline}[args.experiment]
+          "hier": run_hier, "zero1": run_zero1, "fsdp": run_fsdp,
+          "tp": run_tp, "pipeline": run_pipeline}[args.experiment]
     print(f"# {args.experiment} — {args.model}, "
           f"{'bf16' if args.bf16 else 'fp32'}, "
           f"{len(jax.devices())} device(s) [{jax.default_backend()}]\n")

@@ -19,7 +19,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..parallel.collectives import copy_to_tp, reduce_from_tp, tp_all_gather
+from ..parallel.collectives import (
+    TpShardedLogits,
+    copy_to_tp,
+    reduce_from_tp,
+)
 from ..parallel.sharding import PartitionRules
 from .layers import (
     TransformerBlock,
@@ -55,11 +59,12 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
     # enclosing shard_map (training/loop.py's explicit TP x FSDP step).
     # When the padded vocab divides by tp_size, the (vocab, d) embedding —
     # the largest tensor — is vocab-split too: lookups psum the per-shard
-    # partial rows, the tied head computes local logit columns and
-    # all-gathers them over the model axis (one model-axis gather per
-    # step; Megatron's parallel-vocab cross-entropy, which would avoid it,
-    # is a follow-up). Indivisible vocab degrades the embedding to
-    # model-replicated with a warning — the blocks still split.
+    # partial rows, and the tied head returns its LOCAL logit columns as a
+    # `TpShardedLogits` — the task layer computes Megatron's
+    # parallel-vocab cross-entropy from two (B, S)-sized model-axis stats
+    # instead of gathering the (B, S, vocab) logits. Indivisible vocab
+    # degrades the embedding to model-replicated with a warning — the
+    # blocks still split.
     tp_size: int = 1
     tp_axis: Optional[str] = None
 
@@ -172,15 +177,23 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
         x = nn.LayerNorm(epsilon=self.layernorm_epsilon, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln_f")(x)
         if self.tp_vocab:
-            # vocab-parallel tied head: local logit columns, one model-axis
-            # all-gather (`tp_all_gather`: backward takes this shard's
-            # slice of the cotangent — no collective); `copy_to_tp` at the
-            # matmul input so ln_f and the residual stream see the full
-            # summed cotangent
-            logits = tp_all_gather(wte.attend(copy_to_tp(x, self.tp_axis)),
-                                   self.tp_axis, 2)
-        else:
-            logits = wte.attend(x)  # tied LM head (HF ties wte <-> lm_head)
+            # vocab-parallel tied head, Megatron parallel-vocab CE form:
+            # the local logit columns STAY sharded — no vocab-scale
+            # model-axis gather; the loss layer psums two (B, S)-sized
+            # stats instead (collectives.tp_parallel_cross_entropy).
+            # `copy_to_tp` at the matmul input so ln_f and the residual
+            # stream see the full summed cotangent. Padded columns are
+            # masked per shard (global column = shard * rows + j), so the
+            # sharded head is column-for-column the masked gathered one.
+            local = wte.attend(copy_to_tp(x, self.tp_axis)).astype(
+                jnp.float32)
+            cols = (jax.lax.axis_index(self.tp_axis) * vocab_rows
+                    + jnp.arange(vocab_rows))
+            local = jnp.where(cols < self.vocab_size, local,
+                              jnp.finfo(jnp.float32).min)
+            return TpShardedLogits(local, self.tp_axis, vocab_rows,
+                                   self.vocab_size)
+        logits = wte.attend(x)  # tied LM head (HF ties wte <-> lm_head)
         logits = mask_vocab_padding(logits.astype(jnp.float32),
                                     self.vocab_size)
         return logits if cache is None else (logits, tuple(new_cache))

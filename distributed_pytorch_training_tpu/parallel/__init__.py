@@ -13,6 +13,7 @@ from .mesh import (  # noqa: F401
     MODEL,
     PIPE,
     SEQ,
+    SLICE,
     MeshSpec,
     batch_shard_count,
     build_mesh,

@@ -47,6 +47,17 @@ explicit, with the three levers DDP exposes (and two it doesn't):
   all-gather compresses as s8 UPDATE codes + per-chunk fp32 scales
   (`quantized_delta_all_gather` — the hop-2 error model applied to the
   parameter delta).
+* **Topology awareness** (``int8_hier``): the two-tier hierarchical wire
+  for multi-slice fleets (ICI islands joined by DCN — the mesh's ``slice``
+  axis). Per bucket: (1) an EXACT fp32 reduce-scatter inside the slice over
+  the fast tier, (2) the DynamiQ multi-hop s8 codec (per-chunk scales +
+  error feedback, `_int8_multihop_sum` reused verbatim) ACROSS slices on
+  the 1/n_inner partial — the only tier that quantizes, and the only EF
+  site — then (3) an exact intra-slice all-gather back. Slow-link traffic
+  per slice is ~2 bytes/element regardless of the slice count
+  (`hier_wire_bytes` is the accounting); intra-slice arithmetic is exact,
+  so the error model is EXACTLY the flat multihop wire's, at slice
+  granularity (PARITY.md "Exactness model: two-tier sync").
 * **Overlap** is the caller's third lever: `training/loop.py` reduces
   microbatch *i*'s buckets INSIDE the grad-accum scan body, so the
   collective for step *i* has no data dependency on step *i+1*'s compute
@@ -72,15 +83,59 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-WIRE_DTYPES = ("fp32", "bf16", "int8", "int8_multihop")
+WIRE_DTYPES = ("fp32", "bf16", "int8", "int8_multihop", "int8_hier")
 
 # Wire modes whose codec carries an error-feedback residual (built by
 # Trainer.init_state into TrainState.grad_sync).
-EF_WIRE_DTYPES = ("int8", "int8_multihop")
+EF_WIRE_DTYPES = ("int8", "int8_multihop", "int8_hier")
 
 # Quantization grid half-width: int8 values in [-127, 127] (symmetric; -128
 # unused so the grid is zero-centered and dequantization is a pure scale).
 _QMAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# Hierarchy spec (the int8_hier wire's static topology)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HierSpec:
+    """Static two-tier topology of the ``int8_hier`` wire.
+
+    ``slice_axis`` is the slow (DCN) mesh axis, ``fast_axes`` the intra-
+    slice (ICI) batch axes the exact tier reduces over; ``n_slices`` /
+    ``n_inner`` are their sizes (world = n_slices * n_inner). Chunk
+    ownership under the two-stage scatter is FAST-MAJOR: the fast-tier
+    reduce-scatter hands fast-rank j contiguous chunk j, the slow-tier
+    all-to-all then hands slice s sub-chunk s of it — so replica (s, j)
+    owns global chunk ``j * n_slices + s``, which is exactly
+    ``lax.axis_index(fast_axes + (slice_axis,))``. Every hier gather
+    therefore runs slice-axis FIRST, then fast axes, to reassemble chunks
+    in order (``hier_axes`` is the index/PartitionSpec order)."""
+
+    slice_axis: str
+    fast_axes: Tuple[str, ...]
+    n_slices: int
+    n_inner: int
+
+    def __post_init__(self):
+        if self.n_slices < 2:
+            raise ValueError(
+                f"HierSpec needs >= 2 slices (got {self.n_slices}); a "
+                "1-slice mesh has no slow tier — the trainer resolves "
+                "int8_hier to the flat fp32 path there")
+        if self.n_inner < 1:
+            raise ValueError(f"n_inner must be >= 1, got {self.n_inner}")
+
+    @property
+    def world(self) -> int:
+        return self.n_slices * self.n_inner
+
+    @property
+    def hier_axes(self) -> Tuple[str, ...]:
+        """Fast-major ownership order (axis_index / PartitionSpec order)."""
+        return tuple(self.fast_axes) + (self.slice_axis,)
 
 
 # ---------------------------------------------------------------------------
@@ -156,7 +211,7 @@ def padded_total_size(plan: BucketPlan, n_shards: int) -> int:
 
 
 def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
-                           n_shards: int) -> int:
+                           n_shards: int, n_slices: int = 1) -> int:
     """Per-replica wire bytes of ONE full gradient sync under `wire_dtype` —
     the accounting behind the mode table (README) as a measured/recorded
     number in bench and scaling rows, not a docstring claim.
@@ -172,6 +227,12 @@ def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
     * ``int8_multihop``: hop 1 all-to-all moves ~S_padded s8 bytes, hop 2
       all-gather moves ~S_padded s8 bytes — 2·S_padded, independent of n
       (padding adds < n elements per bucket).
+    * ``int8_hier`` (pass ``n_slices``): the fast tier is a flat fp32
+      half+half all-reduce inside the slice — 8·S, exactly the flat fp32
+      formula at the per-slice degree — plus the multihop wire on the
+      1/n_inner partial across slices: 2·S_padded/n_inner slow-tier bytes
+      per replica, i.e. ~2·S DCN bytes PER SLICE independent of the slice
+      count (`hier_wire_bytes` returns the split).
     """
     if wire_dtype not in WIRE_DTYPES:
         raise ValueError(f"unknown wire dtype {wire_dtype!r} "
@@ -179,6 +240,9 @@ def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
     if n_shards <= 1:
         return 0  # passthrough: nothing rides the wire
     s = plan.total_size
+    if wire_dtype == "int8_hier":
+        split = hier_wire_bytes(plan, n_shards, n_slices)
+        return split["ici"] + split["dcn"]
     if wire_dtype == "fp32":
         return 8 * s
     if wire_dtype == "bf16":
@@ -186,6 +250,39 @@ def wire_bytes_per_replica(plan: BucketPlan, wire_dtype: str,
     if wire_dtype == "int8":
         return (n_shards - 1) * s
     return 2 * padded_total_size(plan, n_shards)
+
+
+def hier_wire_bytes(plan: BucketPlan, n_shards: int,
+                    n_slices: int) -> dict:
+    """Per-replica bytes of one ``int8_hier`` sync, split by tier:
+    ``{"ici": fast-tier bytes, "dcn": slow-tier bytes}``.
+
+    Fast tier: exact fp32 reduce-scatter + all-gather inside the slice —
+    together one ring all-reduce's volume, 8·S (identical to the flat fp32
+    formula at the per-slice degree; per-bucket padding, < world elements,
+    is excluded like every formula here excludes sideband noise). Slow
+    tier: the multihop codec on this replica's 1/n_inner partial —
+    2·S_padded/n_inner s8 bytes. Summed over a slice's n_inner replicas
+    that is 2·S_padded DCN bytes per slice, INDEPENDENT of the slice count
+    — the whole point of the hierarchy, and the property tests pin it.
+
+    Raises loudly on infeasible factorizations (world not divisible by
+    the slice count) — the same guard the trainer applies."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if n_shards % n_slices:
+        raise ValueError(
+            f"int8_hier: {n_shards} batch shards do not factor into "
+            f"{n_slices} slices (world % slices != 0)")
+    s = plan.total_size
+    if n_shards <= 1:
+        return {"ici": 0, "dcn": 0}
+    if n_slices == 1:
+        # slices=1 passthrough: the trainer resolves to the flat fp32 path.
+        return {"ici": 8 * s, "dcn": 0}
+    n_inner = n_shards // n_slices
+    return {"ici": 8 * s if n_inner > 1 else 0,
+            "dcn": 2 * padded_total_size(plan, n_shards) // n_inner}
 
 
 def _flat_padded_total(params: Any, n_shards: int) -> int:
@@ -199,7 +296,8 @@ def _flat_padded_total(params: Any, n_shards: int) -> int:
         for leaf in jax.tree_util.tree_leaves(params)))
 
 
-def fsdp_gather_bytes(params: Any, wire_dtype: str, n_shards: int) -> int:
+def fsdp_gather_bytes(params: Any, wire_dtype: str, n_shards: int,
+                      n_slices: int = 1) -> int:
     """Per-replica wire bytes of ONE full per-layer parameter gather pass
     under explicit FSDP (`fsdp_explicit`) — the gather-traffic term
     `wire_bytes_for_config` adds for that mode, recorded in bench/scaling
@@ -211,13 +309,21 @@ def fsdp_gather_bytes(params: Any, wire_dtype: str, n_shards: int) -> int:
     replica. ``int8_multihop`` gathers s8 codes + per-chunk fp32 scales
     (`quantized_shard_all_gather`) — ~1 byte/element, independent of the
     shard count (the delta-gather n-independence argument, applied to the
-    absolute shard values)."""
+    absolute shard values). ``int8_hier`` gathers s8 across slices first
+    (~total/n_inner slow bytes per replica) then exact fp32 inside the
+    slice (~4·total fast bytes) — the slow-tier term is what the mode
+    exists to shrink."""
     if wire_dtype not in WIRE_DTYPES:
         raise ValueError(f"unknown wire dtype {wire_dtype!r} "
                          f"(choose from {WIRE_DTYPES})")
     if n_shards <= 1:
         return 0  # passthrough: nothing rides the wire
     total = _flat_padded_total(params, n_shards)
+    if wire_dtype == "int8_hier":
+        if n_slices <= 1:
+            return 4 * total  # passthrough: the flat exact fp32 gather
+        n_inner = n_shards // n_slices
+        return (4 * total if n_inner > 1 else 0) + total // n_inner
     return total if wire_dtype == "int8_multihop" else 4 * total
 
 
@@ -233,16 +339,22 @@ def tp_psum_bytes_per_step(hidden: int, depth: int, local_batch: int,
     hidden) activation — ~8 bytes/element; the step carries 4 per block
     (forward g + backward f mirrors) plus 2 with the vocab-parallel
     embedding (`Trainer.tp_expected_model_collectives` is the same
-    arithmetic read off the trainer). The vocab-parallel logits gather
-    adds ~4 bytes x (local_batch, seq, padded_vocab) (the (M-1)/M gather
-    volume rounded up, the convention the data-axis formulas use)."""
+    arithmetic read off the trainer). The vocab-parallel head adds the
+    parallel-vocab cross-entropy's two (local_batch, seq, 2)-sized stat
+    all-reduces (~32 bytes x local_batch x seq total) — the vocab-scale
+    logits gather it replaced cost ~4 bytes x (local_batch, seq,
+    padded_vocab), i.e. the head's wire shrank by ~padded_vocab/8 per
+    token (collectives.tp_parallel_cross_entropy). ``padded_vocab`` is
+    kept in the signature for callers recording the replaced-gather
+    delta."""
+    del padded_vocab  # the gather this sized is gone; see docstring
     if model_n <= 1:
         return 0
     act = local_batch * seq * hidden
     n_psums = 4 * depth + (2 if tp_vocab else 0)
     total = 8 * act * n_psums
     if tp_vocab:
-        total += 4 * local_batch * seq * padded_vocab
+        total += 32 * local_batch * seq
     return total
 
 
@@ -267,22 +379,55 @@ def wire_bytes_for_config(params: Any, grad_sync_cfg: Optional[dict],
     move each model shard's local slice only, the 1/M reduction) and the
     model-axis activation term via ``cfg["tp_psum_bytes"]``
     (`tp_psum_bytes_per_step`); the result is the TOTAL data-axis +
-    model-axis per-replica bytes."""
+    model-axis per-replica bytes.
+
+    ``int8_hier`` configs carry ``cfg["slices"]`` (the slice-axis size);
+    `wire_bytes_split_for_config` returns the same number split by tier."""
+    split = wire_bytes_split_for_config(params, grad_sync_cfg, n_shards)
+    return split["ici"] + split["dcn"]
+
+
+def wire_bytes_split_for_config(params: Any, grad_sync_cfg: Optional[dict],
+                                n_shards: int) -> dict:
+    """`wire_bytes_for_config`, split by interconnect tier:
+    ``{"ici": fast-tier bytes, "dcn": slow-tier bytes}``. Every flat wire
+    mode is all-ICI (dcn = 0); ``int8_hier`` puts the cross-slice s8
+    traffic in "dcn" (the `hier_wire_bytes` split, extended with the
+    fsdp gather/scatter terms). Raises loudly when ``cfg["slices"]`` does
+    not divide the batch-shard world."""
     cfg = dict(grad_sync_cfg or {})
     wire = cfg.get("wire_dtype", "fp32")
     if wire not in WIRE_DTYPES:
         raise ValueError(f"unknown wire dtype {wire!r} "
                          f"(choose from {WIRE_DTYPES})")
+    n_slices = int(cfg.get("slices", 1))
+    if n_slices >= 1 and n_shards > 1 and n_shards % n_slices:
+        raise ValueError(
+            f"int8_hier: {n_shards} batch shards do not factor into "
+            f"{n_slices} slices (world % slices != 0)")
     tp_bytes = int(cfg.get("tp_psum_bytes", 0))
+    hier = wire == "int8_hier" and n_slices > 1 and n_shards > 1
     if cfg.get("fsdp_explicit"):
         if n_shards <= 1:
-            return tp_bytes
+            return {"ici": tp_bytes, "dcn": 0}
         total = _flat_padded_total(params, n_shards)
-        scatter = {"fp32": 4, "bf16": 2, "int8": 1,
-                   "int8_multihop": 1}[wire] * total
-        return scatter + fsdp_gather_bytes(params, wire, n_shards) + tp_bytes
+        if hier:
+            n_inner = n_shards // n_slices
+            # scatter: fast fp32 reduce-scatter (4 B/elem) + slow s8
+            # all-to-all on the 1/n_inner partial; gather: the mirror
+            # (fsdp_gather_bytes) — slow-tier total 2·total/n_inner.
+            fast = 8 * total if n_inner > 1 else 0
+            return {"ici": fast + tp_bytes,
+                    "dcn": 2 * (total // n_inner)}
+        scatter = {"fp32": 4, "bf16": 2, "int8": 1, "int8_multihop": 1,
+                   "int8_hier": 4}[wire] * total
+        return {"ici": scatter + fsdp_gather_bytes(params, wire, n_shards)
+                + tp_bytes, "dcn": 0}
     plan = build_bucket_plan(params, float(cfg.get("bucket_cap_mb", 0.0)))
-    return wire_bytes_per_replica(plan, wire, n_shards)
+    if hier:
+        split = hier_wire_bytes(plan, n_shards, n_slices)
+        return {"ici": split["ici"], "dcn": split["dcn"]}
+    return {"ici": wire_bytes_per_replica(plan, wire, n_shards), "dcn": 0}
 
 
 def emit_wire_accounting(params: Any, grad_sync_cfg: Optional[dict],
@@ -306,24 +451,45 @@ def emit_wire_accounting(params: Any, grad_sync_cfg: Optional[dict],
     so ``telemetry summary`` splits TP psum traffic from the data-axis
     gradient sync, and ``wire_bytes_per_replica`` stays the data-axis
     number (tagged axis="data"). With no model axis the emission is
-    byte-identical to before."""
+    byte-identical to before.
+
+    ``int8_hier`` configs (``cfg["slices"]`` > 1): TWO
+    ``wire_bytes_per_replica`` rows, one per interconnect tier —
+    (tier="ici", axis="data") for the exact intra-slice half and
+    (tier="dcn", axis="slice") for the compressed cross-slice half. The
+    rows flow through `telemetry aggregate` and /metrics with zero schema
+    change — (name, tier, axis) was already the rollup key."""
     from .. import telemetry
 
     cfg = dict(grad_sync_cfg or {})
     wire = cfg.get("wire_dtype", "fp32")
     model_shards = int(cfg.get("model_shards", 1))
+    n_slices = int(cfg.get("slices", 1))
     tp_bytes = int(cfg.get("tp_psum_bytes", 0)) if model_shards > 1 else 0
     data_cfg = {k: v for k, v in cfg.items() if k != "tp_psum_bytes"}
+    hier = (wire == "int8_hier" and n_slices > 1 and n_shards > 1)
+    split = wire_bytes_split_for_config(params, data_cfg, n_shards)
     out = {"tier": tier, "wire_dtype": wire, "n_shards": n_shards,
-           "wire_bytes_per_replica": wire_bytes_for_config(
-               params, data_cfg, n_shards)}
+           "wire_bytes_per_replica": split["ici"] + split["dcn"]}
     axis_attr = {"axis": "data"} if model_shards > 1 else {}
-    telemetry.counter("wire_bytes_per_replica",
-                      out["wire_bytes_per_replica"], tier=tier,
-                      wire_dtype=wire, n_shards=n_shards, **axis_attr,
-                      **attrs)
+    if hier:
+        out["wire_bytes_ici"] = split["ici"]
+        out["wire_bytes_dcn"] = split["dcn"]
+        out["n_slices"] = n_slices
+        telemetry.counter("wire_bytes_per_replica", split["ici"],
+                          tier="ici", axis="data", wire_dtype=wire,
+                          n_shards=n_shards, n_slices=n_slices, **attrs)
+        telemetry.counter("wire_bytes_per_replica", split["dcn"],
+                          tier="dcn", axis="slice", wire_dtype=wire,
+                          n_shards=n_shards, n_slices=n_slices, **attrs)
+    else:
+        telemetry.counter("wire_bytes_per_replica",
+                          out["wire_bytes_per_replica"], tier=tier,
+                          wire_dtype=wire, n_shards=n_shards, **axis_attr,
+                          **attrs)
     if cfg.get("fsdp_explicit"):
-        out["fsdp_gather_bytes"] = fsdp_gather_bytes(params, wire, n_shards)
+        out["fsdp_gather_bytes"] = fsdp_gather_bytes(params, wire, n_shards,
+                                                     n_slices)
         telemetry.counter("fsdp_gather_bytes", out["fsdp_gather_bytes"],
                           tier=tier, wire_dtype=wire, n_shards=n_shards,
                           **axis_attr, **attrs)
@@ -580,6 +746,57 @@ def _int8_multihop_sum(v: jnp.ndarray, residual: jnp.ndarray,
     return out[:size], new_residual
 
 
+def _int8_hier_sum(v: jnp.ndarray, residual: jnp.ndarray,
+                   spec: HierSpec, fused: Optional[bool] = None
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two-tier topology-aware SUM of one bucket (the ``int8_hier`` wire):
+    exact fp32 reduce-scatter inside the slice, the multihop s8 codec
+    across slices, exact all-gather back.
+
+    ``v``: this replica's (S,) fp32 bucket contribution. ``residual``: the
+    (S_padded / n_inner,) slow-tier error-feedback residual — S_padded is
+    the bucket rounded up to a multiple of the WORLD (`padded_bucket_bounds`
+    at world), so the fast-tier chunk S_padded/n_inner is itself divisible
+    by n_slices and the reused multihop codec pads nothing further. Returns
+    ``(fp32 (S,) global sum, new residual)``.
+
+    Stage 1 — fast tier, EXACT: a tiled fp32 ``psum_scatter`` over the
+    intra-slice batch axes. Fast-rank j now holds chunk j of the
+    within-slice sum; no quantization, no residual — intra-slice
+    arithmetic is bitwise the same reassociation class as the flat
+    reducer's.
+
+    Stage 2 — slow tier, COMPRESSED: `_int8_multihop_sum` over the slice
+    axis on the 1/n_inner partial, verbatim — per-destination-chunk s8
+    quantization with error feedback (the ONE EF site of the hier wire;
+    the residual telescopes across steps exactly as in the flat multihop
+    wire), s8 all-to-all + requantized s8 all-gather. Its output is
+    replica-identical ACROSS slices at each fast rank, so stage 3's
+    reassembly never mixes divergent values.
+
+    Stage 3 — fast tier, EXACT: a tiled all-gather over the intra-slice
+    axes rebuilds the full bucket (chunks are fast-indexed, so order is
+    restored by construction).
+
+    Four gradient-sized collectives per bucket — two exact f32 on ICI,
+    two s8 on DCN (`analysis.contracts.collectives_per_bucket` == 4; the
+    `hier-tier-signature` HLO rule pins dtype-per-tier). Slow-tier wire
+    bytes: ~2·S per SLICE, independent of the slice count."""
+    size = v.shape[0]
+    padded = residual.shape[0] * spec.n_inner
+    carried = jnp.pad(v, (0, padded - size))
+    if spec.fast_axes:
+        part = lax.psum_scatter(carried, spec.fast_axes,
+                                scatter_dimension=0, tiled=True)
+    else:  # pure cross-slice mesh (n_inner == 1): no fast tier
+        part = carried
+    summed, new_residual = _int8_multihop_sum(
+        part, residual, (spec.slice_axis,), spec.n_slices, fused=fused)
+    if spec.fast_axes:
+        summed = lax.all_gather(summed, spec.fast_axes, axis=0, tiled=True)
+    return summed[:size], new_residual
+
+
 def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
                      n_shards: int, wire_dtype: str,
                      residual: Optional[jnp.ndarray],
@@ -619,27 +836,43 @@ def _compressed_psum(v: jnp.ndarray, axis_names: Sequence[str],
 def reduce_flat(flat: jnp.ndarray, plan: BucketPlan,
                 axis_names: Sequence[str], n_shards: int, wire_dtype: str,
                 residual: Optional[jnp.ndarray] = None,
-                fused: Optional[bool] = None
+                fused: Optional[bool] = None,
+                hier: Optional[HierSpec] = None
                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """Reduce the flat local gradient vector bucket-by-bucket.
 
     ``flat``: this replica's (total_size,) fp32 contribution (weight-scaled
     gradient sums). Returns the globally-summed fp32 vector and the updated
     error-feedback residual (int8 wires only; same shape for ``int8``, the
-    `padded_bucket_bounds` layout for ``int8_multihop``). One collective
-    per bucket (TWO for the multi-hop wire) — the O(buckets) contract
-    `grad_sync_census` verifies in HLO.
+    `padded_bucket_bounds` layout for ``int8_multihop``, that layout's
+    1/n_inner slow-tier view for ``int8_hier`` — which also requires the
+    ``hier`` spec). One collective per bucket (TWO for the multi-hop wire,
+    FOUR for the hierarchical wire: 2 exact f32 on ICI + 2 s8 on DCN) —
+    the O(buckets) contract `grad_sync_census` verifies in HLO.
     """
     multihop = wire_dtype == "int8_multihop"
-    if multihop and residual is None:
+    if wire_dtype == "int8_hier":
+        if hier is None:
+            raise ValueError("int8_hier wire needs a HierSpec (the trainer "
+                             "builds it from the mesh's slice axis)")
+        if residual is None:
+            raise ValueError("int8_hier wire needs a slow-tier error-"
+                             "feedback residual (Trainer.init_state "
+                             "builds it)")
+    elif multihop and residual is None:
         raise ValueError("int8_multihop wire needs a hop-1 error-feedback "
                          "residual (Trainer.init_state builds it)")
-    pbounds = padded_bucket_bounds(plan, n_shards) if multihop else None
+    pbounds = (padded_bucket_bounds(plan, n_shards)
+               if (multihop or wire_dtype == "int8_hier") else None)
     outs: List[jnp.ndarray] = []
     res_outs: List[jnp.ndarray] = []
     for k, (a, b) in enumerate(zip(plan.bounds, plan.bounds[1:])):
         v = lax.slice_in_dim(flat, a, b)
-        if multihop:
+        if wire_dtype == "int8_hier":
+            r = lax.slice_in_dim(residual, pbounds[k] // hier.n_inner,
+                                 pbounds[k + 1] // hier.n_inner)
+            summed, new_r = _int8_hier_sum(v, r, hier, fused=fused)
+        elif multihop:
             r = lax.slice_in_dim(residual, pbounds[k], pbounds[k + 1])
             summed, new_r = _int8_multihop_sum(v, r, axis_names, n_shards,
                                                fused=fused)
@@ -782,6 +1015,70 @@ def compressed_psum_scatter(v: jnp.ndarray, axis_names: Sequence[str],
                              fused=fused), new_residual
 
 
+def hier_psum_scatter(v: jnp.ndarray, spec: HierSpec,
+                      residual: Optional[jnp.ndarray],
+                      fused: Optional[bool] = None
+                      ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Two-tier reduce-scatter of one flat-padded leaf (zero1) or layer-
+    group row stack (explicit FSDP) under the ``int8_hier`` wire.
+
+    ``v``: (padded,) local fp32, padded divisible by the WORLD. Stage 1 is
+    the exact fp32 ``psum_scatter`` over the intra-slice axes (fast-rank j
+    takes chunk j); stage 2 is the s8 all-to-all scatter of `int8` mode
+    over the slice axis on that 1/n_inner partial — the one quantization,
+    with error feedback (``residual`` spans the FULL partial,
+    padded/n_inner elements — EF remembers what was dropped from every
+    destination chunk, the `compressed_psum_scatter` convention). Returns
+    this replica's (padded/world,) chunk of the global sum: chunk index
+    ``j * n_slices + s`` — the FAST-MAJOR ownership `HierSpec.hier_axes`
+    names — plus the updated residual."""
+    if spec.fast_axes:
+        part = lax.psum_scatter(v, spec.fast_axes, scatter_dimension=0,
+                                tiled=True)
+    else:
+        part = v
+    return compressed_psum_scatter(part, (spec.slice_axis,), spec.n_slices,
+                                   "int8", residual, fused=fused)
+
+
+def hier_delta_all_gather(new_shard: jnp.ndarray, old_shard: jnp.ndarray,
+                          old_flat: jnp.ndarray, spec: HierSpec,
+                          fused: Optional[bool] = None) -> jnp.ndarray:
+    """`quantized_delta_all_gather` on the two-tier wire (zero1 x hier
+    param gather): s8 UPDATE codes cross slices, exact fp32 crosses ICI.
+
+    Gather order is slice-axis FIRST: under fast-major ownership replica
+    (s, j) holds chunk ``j * n_slices + s``, so the slice gather rebuilds
+    fast-rank j's contiguous stage-1 chunk, and the fast gather then
+    concatenates those in order. The slow hop carries ~1 byte/element of
+    the 1/n_inner partial; the fast hop is exact (the intra-slice tier
+    never quantizes). Error model: identical to the flat delta gather —
+    every replica dequantizes the same (codes, scales) per slow hop, then
+    gathers exactly, so the reconstruction is replica-identical."""
+    delta = new_shard - old_shard
+    part = _s8_all_gather_dequant(delta, (spec.slice_axis,), fused=fused)
+    if spec.fast_axes:
+        full = lax.all_gather(part, spec.fast_axes, axis=0, tiled=True)
+    else:
+        full = part
+    return old_flat + full
+
+
+def hier_shard_all_gather(shard: jnp.ndarray, spec: HierSpec,
+                          fused: Optional[bool] = None) -> jnp.ndarray:
+    """`quantized_shard_all_gather` on the two-tier wire (explicit FSDP x
+    hier param gather): s8 codes of this replica's at-rest row cross
+    slices (~1 B/element of the partial), then an exact fp32 intra-slice
+    gather rebuilds the full layer group. Same slice-first order as
+    `hier_delta_all_gather` (fast-major ownership); at-rest shards stay
+    exact fp32 — only the per-step gathered working copy carries the
+    bounded slow-hop perturbation."""
+    part = _s8_all_gather_dequant(shard, (spec.slice_axis,), fused=fused)
+    if spec.fast_axes:
+        return lax.all_gather(part, spec.fast_axes, axis=0, tiled=True)
+    return part
+
+
 # ---------------------------------------------------------------------------
 # Error-feedback state constructors (host-side; Trainer.init_state calls)
 # ---------------------------------------------------------------------------
@@ -810,28 +1107,40 @@ def _born_sharded_zeros(structs: Any, mesh, axes=None):
 
 def ef_state_bucketed(params: Any, mesh, n_shards: int,
                       bucket_cap_mb: float = 0.0,
-                      wire_dtype: str = "int8"):
+                      wire_dtype: str = "int8", n_slices: int = 1):
     """Per-replica error-feedback residual for the bucketed reducer: one
     (n_shards, R) fp32 array, row r = replica r's residual, sharded over
     the batch axes so each replica materializes only its row. R is the
     flat gradient size for the ``int8`` gather wire; for ``int8_multihop``
     it is the `padded_bucket_bounds` layout (each bucket padded to a
     multiple of n_shards — the hop-1 residual lives in the codec's padded
-    view, so the bucket cap and wire dtype size the buffer). Consequence:
-    a multihop residual is only meaningful under the bucket plan it was
-    built for — resuming a multihop checkpoint with a different
+    view, so the bucket cap and wire dtype size the buffer); for
+    ``int8_hier`` it is 1/n_inner of that padded layout (each replica's
+    residual covers only its fast-tier partial — the slow tier is the one
+    quantization site, and it only ever sees the partial). Consequence:
+    a multihop/hier residual is only meaningful under the bucket plan it
+    was built for — resuming such a checkpoint with a different
     ``bucket_cap_mb`` is unsupported (the step rejects mismatched residual
     lengths; keep the cap or rebuild the state and let EF restart from
     zero residuals).
     """
     plan = build_bucket_plan(params, bucket_cap_mb)
-    total = (padded_total_size(plan, n_shards)
-             if wire_dtype == "int8_multihop" else plan.total_size)
+    if wire_dtype == "int8_multihop":
+        total = padded_total_size(plan, n_shards)
+    elif wire_dtype == "int8_hier":
+        if n_slices < 2 or n_shards % n_slices:
+            raise ValueError(
+                f"int8_hier EF state needs a feasible factorization; got "
+                f"{n_shards} shards over {n_slices} slices")
+        total = padded_total_size(plan, n_shards) // (n_shards // n_slices)
+    else:
+        total = plan.total_size
     struct = jax.ShapeDtypeStruct((n_shards, total), jnp.float32)
     return {"ef": _born_sharded_zeros(struct, mesh)}
 
 
-def ef_state_fsdp(params: Any, mesh, n_shards: int, model_n: int = 1):
+def ef_state_fsdp(params: Any, mesh, n_shards: int, model_n: int = 1,
+                  n_inner: int = 1):
     """Per-replica residuals for the explicit-FSDP int8 gradient scatter:
     one (n_shards, n_shards * row_size) fp32 array PER LAYER GROUP (the
     scatter is per layer there — `build_layer_plan`), keyed by group name,
@@ -844,13 +1153,20 @@ def ef_state_fsdp(params: Any, mesh, n_shards: int, model_n: int = 1):
     template — each (model shard, data replica) pair runs its own
     data-axis scatter over its local row, so the row dim grows to
     ``model_n * n_shards`` (model-major, matching the at-rest layout) and
-    the rows shard over (model,) + batch axes."""
+    the rows shard over (model,) + batch axes.
+
+    Under the ``int8_hier`` wire pass ``n_inner``: the slow-tier scatter
+    quantizes only the 1/n_inner fast-tier partial of each group, so each
+    residual row shrinks by that factor (n_shards * row_size is a multiple
+    of the world, hence of n_inner; TP x hier is rejected upstream, so
+    model_n and n_inner never both exceed 1)."""
     from .mesh import BATCH_AXES, MODEL
 
     plan = build_layer_plan(params, n_shards)
     structs = {
         g.name: jax.ShapeDtypeStruct(
-            (model_n * n_shards, n_shards * g.row_size), jnp.float32)
+            (model_n * n_shards,
+             n_shards * g.row_size // max(1, n_inner)), jnp.float32)
         for g in plan.groups}
     axes = ((MODEL,) + BATCH_AXES) if model_n > 1 else BATCH_AXES
     return {"ef": _born_sharded_zeros(structs, mesh, axes=axes)}
@@ -932,16 +1248,21 @@ def reshard_fsdp_ef_row(row, old_group: LayerGroup, new_group: LayerGroup,
     return out.reshape(-1)
 
 
-def ef_state_zero1(params: Any, mesh, n_shards: int):
+def ef_state_zero1(params: Any, mesh, n_shards: int, n_inner: int = 1):
     """Per-replica residuals for the zero1 int8 scatter: one
     (n_shards, flat_padded_size) fp32 array PER LEAF (the scatter is
-    per-leaf there), sharded over the batch axes."""
+    per-leaf there), sharded over the batch axes. Under the ``int8_hier``
+    wire pass ``n_inner``: the slow-tier scatter quantizes only the
+    1/n_inner fast-tier partial, so each residual row shrinks by the same
+    factor (flat_padded_size is a multiple of the world, hence of
+    n_inner)."""
     from .sharding import flat_padded_size
 
     structs = jax.tree_util.tree_map(
         lambda p: jax.ShapeDtypeStruct(
             (n_shards,
-             flat_padded_size(int(np.prod(np.shape(p)) or 1), n_shards)),
+             flat_padded_size(int(np.prod(np.shape(p)) or 1), n_shards)
+             // max(1, n_inner)),
             jnp.float32),
         params)
     return {"ef": _born_sharded_zeros(structs, mesh)}

@@ -16,6 +16,10 @@ Axis naming convention (used by all partition rules in `models/`):
 * ``seq``   — sequence/context parallelism (ring attention KV rotation).
 * ``pipe``  — pipeline stages.
 * ``expert``— expert parallelism for MoE layers.
+* ``slice`` — the slow-interconnect outer tier (ICI islands joined by DCN):
+              batch is sharded over it like ``data``, but the hierarchical
+              gradient wire (``--wire-dtype int8_hier``) treats collectives
+              over it as expensive and compresses them (grad_sync.py).
 
 Axis order in the physical mesh matters on TPU: `mesh_utils.create_device_mesh`
 maps the *last* axes onto the tightest ICI rings, so the most
@@ -41,9 +45,14 @@ MODEL = "model"
 SEQ = "seq"
 PIPE = "pipe"
 EXPERT = "expert"
+SLICE = "slice"
 
 # The order axes are laid out in the physical mesh — bandwidth-hungry last.
-AXIS_ORDER: tuple[str, ...] = (PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
+# ``slice`` is OUTERMOST (most-major): linear replica ids group by slice, so
+# consecutive ids share an ICI island and the hierarchical wire's "fast tier"
+# replica groups are contiguous ranges (analysis/hlo_rules.py classifies
+# tiers from exactly this layout).
+AXIS_ORDER: tuple[str, ...] = (SLICE, PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
 
 # The canonical axis-name registry. Code elsewhere must use the constants
 # above (or AXIS_ORDER/BATCH_AXES), never the string literals: the
@@ -53,7 +62,9 @@ AXIS_ORDER: tuple[str, ...] = (PIPE, DATA, FSDP, EXPERT, SEQ, MODEL)
 AXIS_NAMES: frozenset = frozenset(AXIS_ORDER)
 
 # Axes a batch dimension may be sharded over (see sharding.batch_spec).
-BATCH_AXES: tuple[str, ...] = (DATA, FSDP)
+# ``slice`` is a batch axis: a multi-slice fleet runs data parallelism
+# across slices, so every slice-axis size folds into the global batch.
+BATCH_AXES: tuple[str, ...] = (SLICE, DATA, FSDP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,9 +79,11 @@ class MeshSpec:
     seq: int = 1
     pipe: int = 1
     expert: int = 1
+    slice: int = 1
 
     def resolved(self, n_devices: int) -> dict[str, int]:
         sizes = {
+            SLICE: self.slice,
             PIPE: self.pipe,
             DATA: self.data,
             FSDP: self.fsdp,
@@ -133,7 +146,8 @@ def dcn_factors(sizes: dict, n_slices: int) -> tuple[dict, dict]:
     multi-slice pod: ``sizes[a] == per_slice[a] * dcn[a]`` and
     ``prod(dcn) == n_slices``.
 
-    Only the latency-tolerant axes may span DCN — ``data`` first (gradient
+    Only the latency-tolerant axes may span DCN — the explicit ``slice``
+    axis first (it exists to name the DCN tier), then ``data`` (gradient
     all-reduce is once per step and overlappable), then ``pipe``
     (per-microbatch point-to-point activations are small), then ``fsdp``.
     ``model``/``seq``/``expert`` collectives are per-layer and
@@ -142,17 +156,19 @@ def dcn_factors(sizes: dict, n_slices: int) -> tuple[dict, dict]:
     (train_ddp.py:65 — one undifferentiated process group for everything)."""
     dcn = {a: 1 for a in AXIS_ORDER}
     rem = n_slices
-    for a in (DATA, PIPE, FSDP):
-        g = math.gcd(sizes[a], rem)
+    # callers may pass shapes without the (newer) slice axis — absent
+    # axes have size 1 and cannot absorb a DCN factor
+    for a in (SLICE, DATA, PIPE, FSDP):
+        g = math.gcd(sizes.get(a, 1), rem)
         dcn[a] = g
         rem //= g
     if rem != 1:
         raise ValueError(
             f"mesh {sizes} cannot span {n_slices} slices: the slice count "
-            f"must divide into the data/pipe/fsdp axes (model/seq/expert "
-            f"stay within a slice — their collectives need ICI). E.g. for "
-            f"{n_slices} slices use data={n_slices}*k.")
-    per = {a: sizes[a] // dcn[a] for a in AXIS_ORDER}
+            f"must divide into the slice/data/pipe/fsdp axes (model/seq/"
+            f"expert stay within a slice — their collectives need ICI). "
+            f"E.g. for {n_slices} slices use data={n_slices}*k.")
+    per = {a: sizes.get(a, 1) // dcn[a] for a in AXIS_ORDER}
     return per, dcn
 
 

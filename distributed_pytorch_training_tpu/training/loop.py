@@ -40,9 +40,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.collectives import all_gather, psum, psum_scatter, shard_map
 from ..parallel.grad_sync import (
-    EF_WIRE_DTYPES, WIRE_DTYPES, build_bucket_plan, build_layer_plan,
-    compressed_psum_scatter, ef_state_bucketed, ef_state_fsdp,
-    ef_state_zero1, flatten_tree, padded_total_size,
+    EF_WIRE_DTYPES, WIRE_DTYPES, HierSpec, build_bucket_plan,
+    build_layer_plan, compressed_psum_scatter, ef_state_bucketed,
+    ef_state_fsdp, ef_state_zero1, flatten_tree, hier_delta_all_gather,
+    hier_psum_scatter, hier_shard_all_gather, padded_total_size,
     quantized_delta_all_gather, quantized_shard_all_gather, reduce_flat,
     unflatten_tree,
 )
@@ -110,7 +111,24 @@ class TrainConfig:
     # identical dequantized delta to the replicated old params (bounded
     # per-step error, exactly replica-identical, not fed back;
     # grad_sync.quantized_delta_all_gather documents the model).
+    # "int8_hier" is the two-tier topology-aware form on a tiered mesh
+    # (a `slice` axis times the intra-slice batch axes): per bucket, an
+    # EXACT fp32 reduce-scatter inside the slice (the fast ICI tier),
+    # the DynamiQ s8 two-hop exchange ACROSS slices (the slow DCN tier —
+    # the only compressed, error-fed-back stage; ~2 B/element per slice
+    # independent of the slice count), and an exact intra-slice
+    # all-gather back (grad_sync._int8_hier_sum). On a mesh without a
+    # multi-sized slice axis it resolves to the flat fp32 path
+    # (bit-identical passthrough, logged). Composes with grad-accum
+    # overlap, zero1 (hier scatter + s8-over-slice param gather), and
+    # fsdp_explicit's per-layer cut; rejected with explicit TP (the
+    # model axis owns its own wire).
     wire_dtype: str = "fp32"
+    # The mesh axis named as the slow-tier/outer axis for "int8_hier"
+    # (mesh.SLICE by default — `--slices N` populates it). Must be one of
+    # the mesh's batch axes; axes of size 1 (or absent) make int8_hier a
+    # flat-fp32 passthrough.
+    slice_axis: str = "slice"
     # Explicit full-parameter FSDP (SimpleFSDP, PAPERS.md): params AND
     # optimizer moments live flat-sharded 1/N per replica AT REST (the
     # zero1 flat padded layout applied to the parameters themselves), each
@@ -248,6 +266,54 @@ class Trainer:
                        and not self._zero1_gspmd)
         self._grad_sync = (explicit_sync and not config.zero1
                            and not config.fsdp_explicit and multi)
+        # -- two-tier topology-aware wire (int8_hier) ---------------------
+        # Resolve the EFFECTIVE wire dtype and the hierarchy spec ONCE;
+        # every step path and init_state read self._wire / self._hier
+        # (engagement above keys off the REQUESTED dtype, so a resolved
+        # passthrough still runs the explicit reducer — at fp32).
+        self._wire = config.wire_dtype
+        self._hier: Optional[HierSpec] = None
+        if config.wire_dtype == "int8_hier":
+            slice_axis = config.slice_axis
+            if slice_axis not in BATCH_AXES:
+                raise ValueError(
+                    f"int8_hier syncs over the batch axes {BATCH_AXES}; "
+                    f"slice_axis={slice_axis!r} is not one of them — the "
+                    "slow tier must be a data-parallel mesh axis "
+                    "(mesh.SLICE by default, populated by --slices)")
+            n_slices = mesh.shape.get(slice_axis, 1)
+            if n_slices > 0 and self._zero1_n % n_slices:
+                # unreachable when slice_axis is a real batch axis (the
+                # world IS the product of the batch axes) — a loud guard
+                # for hand-built meshes
+                raise ValueError(
+                    f"int8_hier: {self._zero1_n} batch shards do not "
+                    f"factor into {n_slices} slices (world % slices != 0)")
+            if self._tp_n > 1:
+                raise ValueError(
+                    "int8_hier does not compose with explicit TP: the "
+                    "model axis runs megatron psums with their own wire "
+                    "accounting, and the hier codec's fast-tier "
+                    "reduce-scatter would have to thread through them — "
+                    "use int8_multihop under fsdp_explicit x TP, or "
+                    "int8_hier on a model-free mesh")
+            if n_slices > 1:
+                fast = tuple(a for a in BATCH_AXES
+                             if a != slice_axis
+                             and mesh.shape.get(a, 1) > 1)
+                self._hier = HierSpec(
+                    slice_axis=slice_axis, fast_axes=fast,
+                    n_slices=n_slices,
+                    n_inner=self._zero1_n // n_slices)
+            else:
+                # slices=1 passthrough: nothing crosses a slow link, the
+                # hierarchy collapses to the flat EXACT path — bit-for-bit
+                # the fp32 wire (pinned in tests/test_hier.py)
+                self._wire = "fp32"
+                log_main("NOTE: int8_hier requested without a multi-slice "
+                         f"mesh (axis {slice_axis!r} size {n_slices}) — "
+                         "running the flat fp32 wire (bit-identical "
+                         "passthrough)")
         # the per-layer gather plan + unflatten template; built by
         # init_state for fsdp_explicit states (the step needs the original
         # shapes — flat leaves alone cannot be unflattened)
@@ -344,20 +410,43 @@ class Trainer:
 
     def tp_expected_model_collectives(self) -> Tuple[int, int]:
         """(model-axis psums, model-axis gathers) one explicit-TP train
-        step legitimately spends — the `tp-psum-signature` rule's budget
+        step legitimately spends on STRUCTURAL (hidden-activation-sized)
+        collectives — the `tp-psum-signature` rule's budget
         (analysis/hlo_rules.py), derived from the TP model: per block, one
         psum per residual join in the forward (attention out + MLP out)
         and one backward psum per parallel-region input — 4 per block —
         plus the vocab-parallel embedding's lookup psum + head-input
-        backward psum and its one logits all-gather when engaged.
-        (0, 0) when explicit TP is not engaged."""
+        backward psum when engaged. Gathers are 0: the parallel-vocab
+        cross-entropy (collectives.tp_parallel_cross_entropy) replaced
+        the vocab-scale logits gather; its two (B, S, 2)-sized stat
+        collectives are batch-shaped, not hidden-shaped, and are budgeted
+        separately by `tp_expected_ce_stat_elements` so the rule can
+        floor-filter them. (0, 0) when explicit TP is not engaged."""
         if self._tp_n <= 1 or self._tp_model is None:
             return (0, 0)
         depth = getattr(self._tp_model, "depth", None)
         if depth is None:
             return (0, 0)
         tp_vocab = bool(getattr(self._tp_model, "tp_vocab", False))
-        return (4 * depth + (2 if tp_vocab else 0), 1 if tp_vocab else 0)
+        return (4 * depth + (2 if tp_vocab else 0), 0)
+
+    def tp_expected_ce_stat_elements(self, local_rows: int,
+                                     seq_len: int) -> int:
+        """Per-shard element count of EACH of the parallel-vocab CE's two
+        model-axis stat collectives (the stop-gradient pmax and the
+        stacked [sumexp, target-logit] psum — both deliberately
+        (local_rows, seq-1, 2)-shaped so they share one census size
+        class; collectives.tp_parallel_cross_entropy). The
+        `tp-psum-signature` rule adds 2 to the psum budget iff this
+        clears its census floor — the stats are batch-shaped, so whether
+        a given artifact SEES them depends on batch x floor, unlike the
+        hidden-sized structural psums. 0 when the vocab-parallel head is
+        not engaged."""
+        if self._tp_n <= 1 or self._tp_model is None:
+            return 0
+        if not bool(getattr(self._tp_model, "tp_vocab", False)):
+            return 0
+        return 2 * int(local_rows) * max(int(seq_len) - 1, 1)
 
     def tp_wire_bytes(self, local_batch: int, seq_len: int) -> int:
         """Per-replica model-axis wire bytes of one explicit-TP step
@@ -392,6 +481,14 @@ class Trainer:
             cfg["model_shards"] = self._tp_n
             cfg["tp_psum_bytes"] = self.tp_wire_bytes(
                 global_batch // self._zero1_n, seq_len)
+        if self._hier is not None:
+            # the slice factorization lives in the MESH, not the config
+            # dict callers hold — inject the resolved count so the
+            # accounting records the tiered split (and a resolved
+            # passthrough records the flat fp32 wire it actually runs)
+            cfg["slices"] = self._hier.n_slices
+        elif cfg.get("wire_dtype") == "int8_hier":
+            cfg["wire_dtype"] = self._wire  # slices=1 passthrough: fp32
         return params, cfg
 
     def set_mfu_reference(self, flops_per_sample: float,
@@ -596,6 +693,12 @@ class Trainer:
           int8), hop 2 on the requantized partial sum (a bounded per-step
           perturbation, identical on every replica, NOT fed back —
           grad_sync.py documents the bound); convergence pinned.
+        * int8_hier wire: the intra-slice reduce-scatter and all-gather
+          are EXACT fp32 (only reassociation changes vs flat fp32); all
+          compression error comes from the cross-slice s8 multihop stage
+          (hop-1 EF telescoping + hop-2 bounded, the int8_multihop model
+          applied over the slice axis alone — PARITY.md "Exactness
+          model: two-tier sync"); convergence pinned.
         * stochastic tasks / BatchNorm: the zero1 caveats verbatim (each
           shard folds its index into the step RNG; BN normalizes by
           per-shard statistics, torch DDP's per-GPU BN semantics).
@@ -603,7 +706,8 @@ class Trainer:
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
         axes = BATCH_AXES
         task, cfg = self.task, self.config
-        wire, overlap = cfg.wire_dtype, cfg.overlap_grad_sync
+        wire, overlap = self._wire, cfg.overlap_grad_sync
+        hier = self._hier if wire == "int8_hier" else None
         fusedq = cfg.fused_quantize  # tri-state; codecs resolve at trace
         has_stats = bool(jax.tree_util.tree_leaves(state.batch_stats))
         outer = state
@@ -622,8 +726,15 @@ class Trainer:
             # mismatch instead. (Same-size different-layout collisions are
             # possible in principle; changing the cap across a multihop
             # resume is unsupported, documented at ef_state_bucketed.)
-            expect = (padded_total_size(plan, n) if wire == "int8_multihop"
-                      else plan.total_size)
+            if wire == "int8_multihop":
+                expect = padded_total_size(plan, n)
+            elif wire == "int8_hier":
+                # one slow-tier residual slice per replica: the padded
+                # layout divided by the intra-slice degree (the fast
+                # reduce-scatter's output IS the compressed stage's input)
+                expect = padded_total_size(plan, n) // hier.n_inner
+            else:
+                expect = plan.total_size
             got = state.grad_sync["ef"].shape[-1]
             if got != expect:
                 raise ValueError(
@@ -659,7 +770,7 @@ class Trainer:
                 flat = flatten_tree(jax.tree_util.tree_map(
                     lambda a: w * a.astype(jnp.float32), g))
                 flat, ef_l = reduce_flat(flat, plan, axes, n, wire, ef_l,
-                                         fused=fusedq)
+                                         fused=fusedq, hier=hier)
                 s_sum = (jax.tree_util.tree_map(
                     lambda s: w * s.astype(jnp.float32), stats_l)
                     if has_stats else stats)
@@ -684,7 +795,8 @@ class Trainer:
                         # holds already-global sums, and the collective
                         # overlaps the next microbatch's compute
                         flat, ef_c = reduce_flat(flat, plan, axes, n,
-                                                 wire, ef_c, fused=fusedq)
+                                                 wire, ef_c, fused=fusedq,
+                                                 hier=hier)
                     acc = acc + flat
                     if has_stats:
                         s_sum = jax.tree_util.tree_map(
@@ -701,7 +813,7 @@ class Trainer:
                     (micro_batches, keys))
                 if not overlap:
                     flat, ef_l = reduce_flat(flat, plan, axes, n, wire,
-                                             ef_l, fused=fusedq)
+                                             ef_l, fused=fusedq, hier=hier)
 
             # metric fan-in (the zero1 comment verbatim: 3 scalar psums)
             metrics = jax.tree_util.tree_map(
@@ -787,11 +899,22 @@ class Trainer:
         dequantized delta to the replicated old params
         (grad_sync.quantized_delta_all_gather: bounded per-step error,
         replica-identical, not fed back — the hop-2 error model).
+        "int8_hier" tiers both halves over the slice factorization: the
+        scatter is an exact fp32 intra-slice reduce-scatter followed by
+        the s8 cross-slice exchange with error feedback
+        (grad_sync.hier_psum_scatter), and the param gather rides s8
+        UPDATE codes across slices + an exact fp32 intra-slice gather
+        (grad_sync.hier_delta_all_gather) — only the slow tier ever
+        carries compressed bytes. Shard ownership is FAST-MAJOR
+        (HierSpec.hier_axes): chunk j*n_slices+s belongs to (fast j,
+        slice s), so the at-rest flat layout shards over
+        fast_axes+(slice,) instead of the batch axes.
         """
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
         axes = BATCH_AXES
         task = self.task
-        wire = self.config.wire_dtype
+        wire = self._wire
+        hier = self._hier if wire == "int8_hier" else None
         fusedq = self.config.fused_quantize  # tri-state, resolved at trace
         # multihop's scatter half IS the int8 s8 all-to-all (already
         # n-independent); what multihop adds over "int8" here is the
@@ -809,12 +932,19 @@ class Trainer:
         rep = P()
         batch_specs = jax.tree_util.tree_map(
             lambda x: batch_spec(jnp.ndim(x)), batch)
-        opt_specs = dp_flat_specs(state.opt_state)
+        opt_specs = dp_flat_specs(
+            state.opt_state,
+            axes=hier.hier_axes if hier is not None else BATCH_AXES)
 
         def body(params, opt_state, stats, lbatch, key, step, *maybe_ef):
             inner = outer.replace(step=step, params=params,
                                   batch_stats=stats, opt_state=opt_state)
             idx = lax.axis_index(axes)  # linear replica index over the axes
+            # chunk OWNERSHIP index: fast-major under the hier wire (the
+            # fast psum_scatter hands fast-rank j chunk j, the slice
+            # exchange hands slice s sub-chunk s), batch-linear otherwise
+            own = (lax.axis_index(hier.hier_axes) if hier is not None
+                   else idx)
             # per-leaf local residuals, (1, padded) -> (padded,)
             ef_l = (jax.tree_util.tree_map(lambda r: r[0], maybe_ef[0])
                     if use_ef else None)
@@ -837,9 +967,14 @@ class Trainer:
                                if into is not None else [None] * len(g_leaves))
                 outs, new_efs = [], []
                 for a, r, acc in zip(g_leaves, ef_leaves, into_leaves):
-                    s, nr = compressed_psum_scatter(
-                        flatten_pad(a.astype(jnp.float32), n), axes, n,
-                        scatter_wire, r, fused=fusedq)
+                    if hier is not None:
+                        s, nr = hier_psum_scatter(
+                            flatten_pad(a.astype(jnp.float32), n), hier,
+                            r, fused=fusedq)
+                    else:
+                        s, nr = compressed_psum_scatter(
+                            flatten_pad(a.astype(jnp.float32), n), axes, n,
+                            scatter_wire, r, fused=fusedq)
                     outs.append(acc + s if combine else s)
                     new_efs.append(nr)
                 return (jax.tree_util.tree_unflatten(treedef, outs),
@@ -902,7 +1037,7 @@ class Trainer:
             def pshard(p):
                 flat = flatten_pad(p, n)
                 k = flat.size // n
-                return lax.dynamic_slice_in_dim(flat, idx * k, k)
+                return lax.dynamic_slice_in_dim(flat, own * k, k)
 
             p_shards = jax.tree_util.tree_map(pshard, params)
             grads = jax.tree_util.tree_map(
@@ -919,6 +1054,17 @@ class Trainer:
                 new_params = jax.tree_util.tree_map(
                     lambda s, old, p: quantized_delta_all_gather(
                         s, old, flatten_pad(p, n), axes, fused=fusedq,
+                    )[:p.size].reshape(p.shape).astype(p.dtype),
+                    new_p_shards, p_shards, params)
+            elif hier is not None:
+                # two-tier param gather: s8 UPDATE codes + per-chunk fp32
+                # scales cross the slices (bounded, replica-identical, not
+                # fed back — the multihop hop-2 model), then an EXACT fp32
+                # all-gather inside the slice; slice first, fast second,
+                # inverting the fast-major chunk ownership
+                new_params = jax.tree_util.tree_map(
+                    lambda s, old, p: hier_delta_all_gather(
+                        s, old, flatten_pad(p, n), hier, fused=fusedq,
                     )[:p.size].reshape(p.shape).astype(p.dtype),
                     new_p_shards, p_shards, params)
             else:
@@ -1019,7 +1165,14 @@ class Trainer:
         (`quantized_shard_all_gather`: bounded, replica-identical
         perturbation of the gathered WORKING copy — the at-rest shards
         stay exact, so the error does not accumulate into the stored
-        parameters; convergence pinned, not parity).
+        parameters; convergence pinned, not parity). "int8_hier" tiers
+        both wires over the slice factorization: per-layer scatters run
+        the exact fp32 intra-slice reduce-scatter + s8 cross-slice
+        exchange with error feedback (`grad_sync.hier_psum_scatter`),
+        per-layer gathers ride s8 across slices + exact fp32 inside the
+        slice (`grad_sync.hier_shard_all_gather`) — the zero1 hier
+        composition applied per layer group, with FAST-MAJOR at-rest rows
+        (`HierSpec.hier_axes`). Rejected with explicit TP.
         """
         mesh, accum, n = self.mesh, self.config.grad_accum, self._zero1_n
         axes = BATCH_AXES  # the FSDP wire: gathers/scatters ride data only
@@ -1028,7 +1181,8 @@ class Trainer:
         # bind it); the at-rest dim-0 layout is model-major
         axes_all = ((MODEL,) + BATCH_AXES) if tp > 1 else BATCH_AXES
         task, cfg = self.task, self.config
-        wire = cfg.wire_dtype
+        wire = self._wire
+        hier = self._hier if wire == "int8_hier" else None
         fusedq = cfg.fused_quantize  # tri-state, resolved at trace
         scatter_wire = "int8" if wire == "int8_multihop" else wire
         use_ef = wire in EF_WIRE_DTYPES
@@ -1045,7 +1199,10 @@ class Trainer:
         if use_ef:
             for g in plan.groups:
                 got = state.grad_sync["ef"][g.name].shape[-1]
-                expect = n * g.row_size
+                # hier: one slow-tier residual per replica per group —
+                # the padded group row divided by the intra-slice degree
+                expect = (n * g.row_size
+                          // (hier.n_inner if hier is not None else 1))
                 if got != expect:
                     raise ValueError(
                         f"error-feedback residual for layer group "
@@ -1068,8 +1225,12 @@ class Trainer:
         rep = P()
         batch_specs = jax.tree_util.tree_map(
             lambda x: batch_spec(jnp.ndim(x)), batch)
-        param_specs = dp_flat_specs(state.params, axes=axes_all)
-        opt_specs = dp_flat_specs(state.opt_state, axes=axes_all)
+        # hier wire: at-rest rows bind FAST-MAJOR (the scatter's chunk
+        # ownership — see _zero1_step), so dim 0 shards over
+        # fast_axes+(slice,) instead of the batch-axis order
+        rest_axes = hier.hier_axes if hier is not None else axes_all
+        param_specs = dp_flat_specs(state.params, axes=rest_axes)
+        opt_specs = dp_flat_specs(state.opt_state, axes=rest_axes)
 
         def body(p_shards, opt_state, stats, lbatch, key, step, *maybe_ef):
             idx = lax.axis_index(axes)
@@ -1096,6 +1257,10 @@ class Trainer:
                 if wire == "int8_multihop":
                     flatg = quantized_shard_all_gather(row, axes,
                                                        fused=fusedq)
+                elif hier is not None:
+                    # s8 across slices, exact fp32 inside — slice first,
+                    # fast second, inverting fast-major row ownership
+                    flatg = hier_shard_all_gather(row, hier, fused=fusedq)
                 else:
                     flatg = all_gather(row, axes)
                 prev = flatg
@@ -1137,8 +1302,12 @@ class Trainer:
                     v = (jnp.concatenate(parts, axis=1)
                          if len(parts) > 1 else parts[0]).reshape(-1)
                     r = ef_tree[g.name] if use_ef else None
-                    s_out, nr = compressed_psum_scatter(
-                        v, axes, n, scatter_wire, r, fused=fusedq)
+                    if hier is not None:
+                        s_out, nr = hier_psum_scatter(v, hier, r,
+                                                      fused=fusedq)
+                    else:
+                        s_out, nr = compressed_psum_scatter(
+                            v, axes, n, scatter_wire, r, fused=fusedq)
                     off = 0
                     for s, c in zip(g.leaf_slots, g.chunk_sizes):
                         chunk = lax.slice_in_dim(s_out, off, off + c)
@@ -1269,8 +1438,10 @@ class Trainer:
         # sharding; the rules would replicate them). zero1 feeds back on
         # its scatter half under both int8 forms ("int8_multihop" scatters
         # via the same s8 all-to-all; only its param gather differs).
-        use_ef = (self.config.wire_dtype in EF_WIRE_DTYPES
+        use_ef = (self._wire in EF_WIRE_DTYPES
                   and (self._zero1 or self._grad_sync or self._fsdp))
+        hier = self._hier if self._wire == "int8_hier" else None
+        n_inner = hier.n_inner if hier is not None else 1
         if self._fsdp:
             # Explicit FSDP: params AND moments are born in the zero1 flat
             # padded layout, 1/N per replica at rest — the at-rest memory
@@ -1340,7 +1511,11 @@ class Trainer:
                 flat_params = fsdp_tp_flat_params(
                     params, self.mesh, n, tp, split_dims, axes_all)
             else:
-                opt_state = zero1_opt_state(tx, params, self.mesh)
+                # hier wire: moments born in the fast-major row binding
+                # the step's specs use (params reshard once, first step)
+                opt_state = zero1_opt_state(
+                    tx, params, self.mesh,
+                    axes=hier.hier_axes if hier is not None else None)
                 flat_params = fsdp_flat_params(params, self.mesh, n)
             state = TrainState.create(
                 apply_fn=model.apply, params=params, tx=tx,
@@ -1350,7 +1525,8 @@ class Trainer:
             placed = placed.replace(params=flat_params, opt_state=opt_state)
             if use_ef:
                 placed = placed.replace(grad_sync=ef_state_fsdp(
-                    local_template, self.mesh, n, model_n=tp))
+                    local_template, self.mesh, n, model_n=tp,
+                    n_inner=n_inner))
             return placed
         if self._zero1 or self._zero1_gspmd:
             # Params stay replicated (the DDP layout — zero1 shards only
@@ -1358,7 +1534,10 @@ class Trainer:
             # over the batch axes, 1/N per replica.
             from .optim import zero1_opt_state
 
-            opt_state = zero1_opt_state(tx, params, self.mesh)
+            opt_state = zero1_opt_state(
+                tx, params, self.mesh,
+                axes=hier.hier_axes if (hier is not None and self._zero1)
+                else None)
             state = TrainState.create(
                 apply_fn=model.apply, params=params, tx=tx,
                 batch_stats=batch_stats, opt_state=opt_state)
@@ -1367,7 +1546,7 @@ class Trainer:
             placed = placed.replace(opt_state=opt_state)
             if use_ef:
                 placed = placed.replace(grad_sync=ef_state_zero1(
-                    params, self.mesh, self._zero1_n))
+                    params, self.mesh, self._zero1_n, n_inner=n_inner))
             return placed
         state = TrainState.create(
             apply_fn=model.apply, params=params, tx=tx, batch_stats=batch_stats)
@@ -1376,7 +1555,8 @@ class Trainer:
             placed = placed.replace(grad_sync=ef_state_bucketed(
                 params, self.mesh, self._zero1_n,
                 bucket_cap_mb=self.config.bucket_cap_mb,
-                wire_dtype=self.config.wire_dtype))
+                wire_dtype=self._wire,
+                n_slices=hier.n_slices if hier is not None else 1))
         return placed
 
     # -- epoch loops -------------------------------------------------------

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 
 from ..data.augment import normalize_images, random_crop_flip
+from ..parallel.collectives import TpShardedLogits, tp_parallel_cross_entropy
 
 Metrics = Dict[str, jnp.ndarray]
 
@@ -118,8 +119,19 @@ class LanguageModelingTask(Task):
             rngs=rngs)
         # shift: predict ids[:, 1:] from logits[:, :-1]
         tgt = ids[:, 1:]
-        lg = logits[:, :-1].astype(jnp.float32)
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(lg, tgt)
+        if isinstance(logits, TpShardedLogits):
+            # vocab-parallel head (explicit TP): Megatron parallel-vocab
+            # CE over the local logit columns — two (B, S, 2)-sized
+            # model-axis stats instead of a vocab-scale logits gather
+            # (parallel/collectives.tp_parallel_cross_entropy). Same
+            # train and eval path.
+            per_tok, predicted = tp_parallel_cross_entropy(
+                logits.map_local(lambda x: x[:, :-1]), tgt)
+        else:
+            lg = logits[:, :-1].astype(jnp.float32)
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                lg, tgt)
+            predicted = jnp.argmax(lg, axis=-1) == tgt
         w = batch["weight"][:, None] * jnp.ones_like(per_tok)
         wsum = w.sum()
         loss = (per_tok * w).sum() / jnp.maximum(wsum, 1.0)
@@ -129,7 +141,7 @@ class LanguageModelingTask(Task):
                 aux = (sum(jnp.asarray(a).mean() for a in aux_leaves)
                        / len(aux_leaves))
                 loss = loss + self.aux_loss_weight * aux
-        correct = ((jnp.argmax(lg, axis=-1) == tgt) * w).sum()
+        correct = (predicted * w).sum()
         metrics = {"loss_sum": (per_tok * w).sum(), "correct": correct,
                    "weight": wsum}
         return loss, (metrics, state.batch_stats)

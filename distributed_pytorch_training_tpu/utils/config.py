@@ -62,6 +62,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                         help="synthetic dataset size override")
     parser.add_argument("--mesh", default="data=-1", type=str,
                         help="mesh spec, e.g. 'data=4,model=2' (default: pure DP)")
+    parser.add_argument("--slices", default=1, type=int,
+                        help="factor the data-parallel world into this many "
+                             "topology slices (the outer/slow-tier mesh "
+                             "axis, e.g. TPU pods joined by DCN): folds a "
+                             "'slice=N' axis into --mesh, the tier "
+                             "--wire-dtype int8_hier compresses across. 1 "
+                             "= flat topology (default). The world must "
+                             "factor: remaining data shards = world/N")
+    parser.add_argument("--slice-axis", default="slice", type=str,
+                        help="mesh axis name int8_hier treats as the slow "
+                             "tier (default 'slice', the axis --slices "
+                             "populates); must be one of the mesh's batch "
+                             "axes")
     parser.add_argument("--microbatches", default=4, type=int,
                         help="GPipe microbatches per step when the mesh has "
                              "a pipe axis > 1 (bubble fraction "
@@ -93,7 +106,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "scheduled sync (the default). Incompatible "
                              "with --zero1")
     parser.add_argument("--wire-dtype", default="fp32", type=str,
-                        choices=["fp32", "bf16", "int8", "int8_multihop"],
+                        choices=["fp32", "bf16", "int8", "int8_multihop",
+                                 "int8_hier"],
                         help="gradient wire dtype for the explicit sync "
                              "path: bf16 halves the wire bytes; int8 adds "
                              "per-bucket scales + error feedback (bucketed "
@@ -102,11 +116,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "int8_multihop is the n-independent DynamiQ "
                              "form (s8 reduce-scatter, requantize, s8 "
                              "all-gather — 2 collectives/bucket, ~2 "
-                             "B/element at any DP degree); master "
-                             "accumulation and the optimizer stay fp32. "
-                             "bf16/int8 compose with --zero1 (the reduce-"
-                             "scatter half compresses, n-independently); "
-                             "int8_multihop + --zero1 is rejected")
+                             "B/element at any DP degree); int8_hier is "
+                             "the two-tier topology-aware form on a "
+                             "--slices factored mesh (exact fp32 reduce-"
+                             "scatter inside a slice, the s8 multihop "
+                             "exchange ACROSS slices — slow-link bytes ~2 "
+                             "B/element per slice independent of the slice "
+                             "count, exact intra-slice gather back); "
+                             "master accumulation and the optimizer stay "
+                             "fp32. bf16/int8 compose with --zero1 (the "
+                             "reduce-scatter half compresses, n-"
+                             "independently); int8_multihop + --zero1 is "
+                             "rejected; int8_hier composes with --zero1 "
+                             "and --fsdp-explicit but not explicit TP")
     parser.add_argument("--fused-quantize", default="auto", type=str,
                         choices=["auto", "on", "off"],
                         help="fused Pallas int8 codec kernels "

@@ -23,6 +23,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "gsync_fp32", "gsync_bf16", "gsync_int8",
                              "gsync_bf16_accum", "gsync_int8_mh",
                              "gsync_int8_mh_accum", "gsync_int8_mh_fused",
+                             "gsync_int8_hier", "gsync_int8_hier_accum",
+                             "zero1_int8_hier",
                              "fsdp", "fsdp_accum", "fsdp_int8_mh",
                              "fsdp_tp", "fsdp_tp_int8_mh",
                              "serving_decode", "elastic_reshard",
@@ -30,8 +32,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran, incl. the fsdp rules (ISSUE 7), the
     # serving decode-step rules (ISSUE 10), the elastic census pins in
-    # BOTH directions (ISSUEs 11 + 12), and the 2-D TP x FSDP rules
-    # (ISSUE 13)
+    # BOTH directions (ISSUEs 11 + 12), the 2-D TP x FSDP rules
+    # (ISSUE 13), and the two-tier hier wire rules (ISSUE 16)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
     assert "fsdp-layer-gather-bound" in kinds
@@ -40,6 +42,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "elastic-reshard-census" in kinds
     assert "elastic-grow-census" in kinds
     assert "tp-psum-signature" in kinds
+    assert "hier-tier-signature" in kinds
     assert "fsdp-gather-rides-data-only" in kinds
     assert "span-names-registered" in kinds
     assert "profiler-session-via-stepprofiler-only" in kinds

@@ -394,7 +394,10 @@ class TestDpSyncPresentRule:
 
 class TestMultihopBound:
     def test_collectives_per_bucket_by_mode(self):
-        assert [collectives_per_bucket(m) for m in WIRE_MODES] == [1, 1, 1, 2]
+        # fp32/bf16/int8 single-hop, int8_multihop 2 hops, int8_hier 2
+        # exact ICI + 2 s8 DCN
+        assert [collectives_per_bucket(m) for m in WIRE_MODES] == \
+            [1, 1, 1, 2, 4]
         with pytest.raises(ValueError, match="unknown wire mode"):
             collectives_per_bucket("int4")
 

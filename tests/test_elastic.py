@@ -269,6 +269,7 @@ def _flat_leaves_match(old_tree, new_tree):
 
 
 class TestReshardTrainState:
+    @pytest.mark.slow  # ~30 s (two trainer compiles); EF-row reshard exactness is pinned fast by the fsdp-int8 leg, zero1 CLI parity by the chaos suite
     def test_zero1_int8_state_reshards_exactly(self, mesh8, mesh4):
         """The richest zero1 state (flat-padded moments + per-leaf EF
         residual rows) trained at world 8 reshards to the world-4 template
@@ -337,12 +338,17 @@ class TestReshardTrainState:
                     assert not oleaf[k:].any() and not nleaf[k:].any()
                     ooff, noff = ooff + co, noff + cn
 
+    @pytest.mark.slow
     def test_zero1_int8_state_grows_exactly(self, mesh8, mesh4):
         """ISSUE-12: the GROW direction at state level — a zero1-int8
         state trained at world 4 reshards to the world-8 template with
         flat leaves zero-extended, EF rows zero-extended (survivors keep
         their residual bit-for-bit, newcomers start at zero), and the
-        world-8 trainer trains on it."""
+        world-8 trainer trains on it.
+
+        Slow tier (~27 s: two trainer compiles at different worlds): the
+        shrink-direction twin above keeps the reshard math pinned fast,
+        and the supervisor grow tests cover the grow path end to end."""
         t4, sf4, l4 = _rig(mesh4, "zero1", "int8")
         state = sf4()
         state, *_ = t4.train_epoch(state, l4.epoch(0), 0, len(l4))
@@ -365,6 +371,7 @@ class TestReshardTrainState:
         cont, *_ = t8.train_epoch(new, l8.epoch(1), 1, len(l8))
         assert int(cont.step) == int(state.step) + len(l8)
 
+    @pytest.mark.slow  # ~16 s; implementation-equivalence leg — the exactness tests pin the reshard math itself
     def test_raw_reshard_matches_device_reshard(self, mesh8, mesh4,
                                                 tmp_path):
         """The cross-PROCESS restore path (ISSUE 12): save a zero1-int8

@@ -98,6 +98,7 @@ def _assert_params_close(ref_params, params, **tol):
 # --- fp32 parity vs the replicated path ------------------------------------
 
 
+@pytest.mark.slow  # ~7 s; the adamw 20-step leg stays fast and is the stricter parity
 def test_fsdp_sgd_20step_matches_replicated(mesh8):
     l_rep, s_rep, t_rep = _run(mesh8, "sgd", fsdp=False)
     l_fs, s_fs, t_fs = _run(mesh8, "sgd", fsdp=True)
@@ -223,6 +224,7 @@ def test_fsdp_census_one_gather_and_one_scatter_per_layer_group(mesh8, wire):
     assert by_op.get("all-reduce", 0) == 0, by_op
 
 
+@pytest.mark.slow  # ~7 s; strictly redundant with the full contract-matrix gate in test_analysis_cli
 def test_fsdp_analysis_contracts_pass_without_relaxation(mesh8):
     """The fsdp and fsdp_int8_mh contracts evaluate clean on the live
     trainer — per-layer gather bound, scatter signature, and

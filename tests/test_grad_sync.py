@@ -140,6 +140,7 @@ class TestBucketPlan:
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~9 s; bucketing parity stays fast via the adamw leg and bucket-boundaries test
 def test_bucketed_fp32_matches_implicit(mesh8):
     l_imp, s_imp = _run(mesh8)
     l_b, s_b = _run(mesh8, bucket_cap_mb=0.05)
@@ -666,6 +667,7 @@ def test_census_rejects_unengaged_bucketing(mesh8):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~10 s; bf16 wire and zero1 are each pinned fast separately (bf16 converges, zero1 multihop parity)
 def test_zero1_bf16_wire_matches_zero1_fp32(mesh8):
     from distributed_pytorch_training_tpu.experiments.trace_analysis import (
         grad_sync_census, preopt_hlo_text,

@@ -1,0 +1,584 @@
+"""Two-tier topology-aware gradient sync (ISSUE 16: wire_dtype='int8_hier'
+on a sliced mesh — exact fp32 reduce-scatter/all-gather INSIDE a slice,
+compressed s8 + error-feedback multihop exchange ACROSS slices).
+
+The contracts pinned here:
+
+(a) **Parity.** The hierarchical wire is a perturbation of the slow tier
+    only: 20-step loss trajectories track flat fp32 at the compressed
+    tolerance (grad-accum off AND on), and the slow-tier EF residual rows
+    (the 1/n_inner partial layout) are alive after a step.
+
+(b) **slices=1 passthrough.** int8_hier on a mesh without a real slice
+    axis resolves to the flat fp32 path BEFORE tracing — trajectories and
+    params are BIT-identical to wire_dtype='fp32' (loop.py documents this
+    file as the pin).
+
+(c) **Codec math.** `_int8_hier_sum` via `reduce_flat` on the real
+    (slice=2, data=4) CPU mesh: grid values round-trip bit-exactly, the
+    one-shot error obeys the two-quantization bound on the SLOW tier only
+    (the fast tier is exact by construction), and the slow-tier EF
+    telescopes.
+
+(d) **Wire accounting.** `hier_wire_bytes`: per-slice slow-tier bytes are
+    INDEPENDENT of the slice count (the point of the hierarchy), the fast
+    tier prices as flat fp32 at the per-slice degree, infeasible
+    factorizations raise.
+
+(e) **The tier census.** The gsync_int8_hier contract lowers clean under
+    the full rule suite with exactly n_buckets collectives per hop per
+    tier, and `hier-tier-signature` / `no-fp32-wire` flag each synthetic
+    mutation (flat traffic wearing the two-tier flag, a missing hop, f32
+    crossing slices) while abstaining on the slices=1 passthrough.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+from distributed_pytorch_training_tpu.parallel import (
+    MeshSpec, build_mesh, shard_batch,
+)
+from distributed_pytorch_training_tpu.parallel.collectives import shard_map
+from distributed_pytorch_training_tpu.parallel.grad_sync import (
+    HierSpec, build_bucket_plan, hier_wire_bytes, padded_total_size,
+    reduce_flat, wire_bytes_per_replica,
+)
+from distributed_pytorch_training_tpu.training import TrainConfig, Trainer
+from distributed_pytorch_training_tpu.training.optim import sgd
+from distributed_pytorch_training_tpu.training.tasks import LanguageModelingTask
+
+SEQ = 16
+VOCAB = 64
+
+# The test topology: 2 slices x 4 intra-slice shards on the 8 virtual CPU
+# devices — the same factorization the hier contracts lower on.
+N_SLICES = 2
+N_INNER = 4
+HSPEC = HierSpec(slice_axis="slice", fast_axes=("data",),
+                 n_slices=N_SLICES, n_inner=N_INNER)
+
+
+@pytest.fixture(scope="module")
+def hier_mesh(devices):
+    return build_mesh(MeshSpec.parse("slice=2,data=4"), devices=devices)
+
+
+def _tiny_gpt2():
+    return GPT2LMHead(vocab_size=VOCAB, hidden_dim=32, depth=2, num_heads=2,
+                      max_position=SEQ)
+
+
+def _trainer(mesh, **cfg):
+    t = Trainer(LanguageModelingTask(), mesh, TrainConfig(seed=0, **cfg))
+    state = t.init_state(_tiny_gpt2(), np.zeros((1, SEQ), np.int32),
+                         sgd(0.1, momentum=0.9, weight_decay=5e-4),
+                         jax.random.PRNGKey(0))
+    return t, state
+
+
+def _batch(mesh, n=16):
+    rng = np.random.RandomState(0)
+    return shard_batch({
+        "input_ids": rng.randint(0, VOCAB, (n, SEQ)).astype(np.int32),
+        "weight": np.ones(n, np.float32),
+    }, mesh)
+
+
+def _run(mesh, steps=4, **cfg):
+    t, s = _trainer(mesh, **cfg)
+    batch = _batch(mesh)
+    key = jax.random.PRNGKey(1)
+    losses = []
+    for _ in range(steps):
+        s, m = t._train_step(s, batch, key)
+        losses.append(float(m["loss_sum"]) / max(float(m["weight"]), 1.0))
+    return losses, s
+
+
+# ---------------------------------------------------------------------------
+# (a) Parity on the sliced mesh
+# ---------------------------------------------------------------------------
+
+
+def test_hier_parity_20_steps(hier_mesh):
+    """ISSUE-16 acceptance: fp32-vs-int8_hier loss trajectories agree
+    within tolerance over 20 steps on the (slice=2, data=4) mesh. The fast
+    tier is exact, so all perturbation comes from the slow-tier multihop
+    on the 1/n_inner partial — same error model as int8_multihop, smaller
+    payload."""
+    l_fp, _ = _run(hier_mesh, steps=20)
+    l_h, s_h = _run(hier_mesh, steps=20, bucket_cap_mb=0.05,
+                    wire_dtype="int8_hier")
+    assert l_h[-1] < l_h[0]
+    np.testing.assert_allclose(l_fp, l_h, rtol=3e-2)
+    # slow-tier EF residuals: per-replica rows over the 1/n_inner view of
+    # the padded layout (ONE feedback site, on the slow tier)
+    plan = build_bucket_plan(s_h.params, 0.05)
+    ef = np.asarray(jax.device_get(s_h.grad_sync["ef"]))
+    assert ef.shape == (8, padded_total_size(plan, 8) // N_INNER)
+    assert np.abs(ef).max() > 0.0
+
+
+def test_hier_parity_20_steps_grad_accum(hier_mesh):
+    """Grad-accum ON: the slow-tier residual is carried through the
+    microbatch scan. Per-step bound coarse, time-averaged tail tight —
+    the multihop grad-accum test documents why (this tiny high-LR task is
+    chaotic by step ~18)."""
+    l_fp, _ = _run(hier_mesh, steps=20, grad_accum=2)
+    l_h, _ = _run(hier_mesh, steps=20, grad_accum=2, bucket_cap_mb=0.05,
+                  wire_dtype="int8_hier")
+    assert l_h[-1] < l_h[0]
+    np.testing.assert_allclose(l_fp, l_h, rtol=1.5e-1)
+    np.testing.assert_allclose(np.mean(l_fp[-5:]), np.mean(l_h[-5:]),
+                               rtol=2e-2)
+
+
+@pytest.mark.slow
+def test_zero1_hier_parity_20_steps(hier_mesh):
+    """zero1 x int8_hier (the zero1_int8_hier contract's training-side
+    twin): sharded optimizer state with the tiered wire still tracks fp32
+    at lr=0.05 (the zero1 multihop test documents the saner-LR choice).
+
+    Slow tier: the fast gate already lowers and tier-checks this exact
+    composition via the zero1_int8_hier contract in the analysis matrix."""
+    def run(wire):
+        t = Trainer(LanguageModelingTask(), hier_mesh,
+                    TrainConfig(seed=0, zero1=True, wire_dtype=wire))
+        s = t.init_state(_tiny_gpt2(), np.zeros((1, SEQ), np.int32),
+                         sgd(0.05, momentum=0.9, weight_decay=5e-4),
+                         jax.random.PRNGKey(0))
+        batch = _batch(hier_mesh)
+        key = jax.random.PRNGKey(1)
+        losses = []
+        for _ in range(20):
+            s, m = t._train_step(s, batch, key)
+            losses.append(float(m["loss_sum"])
+                          / max(float(m["weight"]), 1.0))
+        return losses
+
+    l_fp = run("fp32")
+    l_h = run("int8_hier")
+    assert l_h[-1] < l_h[0]
+    np.testing.assert_allclose(l_fp, l_h, rtol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# (b) slices=1 passthrough: bit-identical to the flat fp32 wire
+# ---------------------------------------------------------------------------
+
+
+def test_slices1_passthrough_is_bitwise_fp32(mesh8):
+    """On a mesh without a real slice axis the trainer resolves int8_hier
+    to the flat fp32 path BEFORE tracing (loop.py pins this file): same
+    compiled program, bit-identical trajectory and params."""
+    t_h, s_h = _trainer(mesh8, bucket_cap_mb=0.05, wire_dtype="int8_hier")
+    assert t_h._hier is None and t_h._wire == "fp32"
+    l_h, s_h = _run(mesh8, steps=3, bucket_cap_mb=0.05,
+                    wire_dtype="int8_hier")
+    l_fp, s_fp = _run(mesh8, steps=3, bucket_cap_mb=0.05)
+    assert l_h == l_fp  # exact equality, not allclose
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))),
+        s_h.params, s_fp.params)
+
+
+def test_wire_accounting_inputs_record_resolved_topology(hier_mesh, mesh8):
+    """The accounting assembly both train.py and bench use: on a sliced
+    mesh the resolved slice count is injected (the factorization lives in
+    the MESH, not the caller's config dict); on a slice-free mesh the
+    passthrough records the flat fp32 wire it actually runs."""
+    cfg_in = {"wire_dtype": "int8_hier", "bucket_cap_mb": 0.05}
+    t, s = _trainer(hier_mesh, **cfg_in)
+    _, cfg = t.wire_accounting_inputs(s, cfg_in, 16, SEQ)
+    assert cfg["slices"] == N_SLICES
+    assert cfg["wire_dtype"] == "int8_hier"
+    t1, s1 = _trainer(mesh8, **cfg_in)
+    _, cfg1 = t1.wire_accounting_inputs(s1, cfg_in, 16, SEQ)
+    assert cfg1["wire_dtype"] == "fp32"
+    assert "slices" not in cfg1
+
+
+# ---------------------------------------------------------------------------
+# (c) Codec math on the real (slice=2, data=4) mesh
+# ---------------------------------------------------------------------------
+
+
+def _hier_reduce_fn(mesh, plan):
+    """jitted (contribs (8, S), ef (8, R)) -> (sums (8, S), new ef): the
+    hier codec run inside a shard_map over the sliced mesh, one
+    contribution row per replica (row r = slice r//4, fast rank r%4 —
+    slice-major device ids, mesh.AXIS_ORDER)."""
+    def body(x, ef):
+        out, new_ef = reduce_flat(x.reshape(-1), plan, ("slice", "data"), 8,
+                                  "int8_hier", ef.reshape(-1), hier=HSPEC)
+        return out[None], new_ef[None]
+
+    spec = P(("slice", "data"))
+    return jax.jit(shard_map(body, mesh, in_specs=(spec, spec),
+                             out_specs=(spec, spec)))
+
+
+class TestHierCodec:
+    """Unit contracts of `_int8_hier_sum` via `reduce_flat` (real
+    collectives on the sliced CPU mesh, no cluster)."""
+
+    S = 1000  # not divisible by 8 — exercises the padded layout
+    CAP = 400 * 4 / (1024 ** 2)  # 400-element buckets: sizes 400/400/200
+
+    def _plan(self):
+        return build_bucket_plan({"a": np.zeros(self.S)}, self.CAP)
+
+    def _ef0(self, plan):
+        # slow-tier residual: the 1/n_inner view of the padded layout
+        return np.zeros((8, padded_total_size(plan, 8) // N_INNER),
+                        np.float32)
+
+    def test_exact_on_grid_values(self, hier_mesh):
+        """Integer contributions with every chunk's max-abs pinned to 127:
+        the intra-slice partial is 4x an integer row (max-abs 508 -> the
+        slow-tier hop-1 scale is EXACTLY 4.0 in fp32, hop-2's exactly 8.0
+        — power-of-two multiples of the 127 grid), so the full two-tier
+        round trip is bit-exact with an all-zero residual. Any deviation
+        is codec math, not quantization."""
+        plan = self._plan()
+        rng = np.random.RandomState(0)
+        row = rng.randint(-127, 128, self.S).astype(np.float32)
+        row[::10] = 127.0
+        contribs = np.tile(row, (8, 1))
+        out, ef = _hier_reduce_fn(hier_mesh, plan)(contribs, self._ef0(plan))
+        np.testing.assert_array_equal(np.asarray(out)[0], 8.0 * row)
+        np.testing.assert_array_equal(np.asarray(ef), 0.0)
+
+    def test_one_shot_error_bounded_by_slow_tier_quanta(self, hier_mesh):
+        """|hier - exact| obeys the multihop two-quantization bound
+        computed on the INTRA-SLICE PARTIAL SUMS (the only values that
+        ever meet a quantizer — the fast tier is exact): hop-1 half-quanta
+        of the n_slices senders plus the hop-2 half-quantum."""
+        plan = self._plan()
+        rng = np.random.RandomState(1)
+        contribs = rng.randn(8, self.S).astype(np.float32)
+        exact = contribs.sum(0)
+        # the slow tier quantizes the per-slice partials, not raw rows
+        inner = contribs.reshape(N_SLICES, N_INNER, self.S).sum(1)
+        out, ef = _hier_reduce_fn(hier_mesh, plan)(contribs, self._ef0(plan))
+        out = np.asarray(out)[0]
+        for a, b in zip(plan.bounds, plan.bounds[1:]):
+            seg = slice(a, b)
+            hop1 = N_SLICES * (np.abs(inner[:, seg]).max() / 127.0) / 2
+            hop2 = (np.abs(exact[seg]).max() + hop1) / 127.0 / 2
+            err = np.abs(out[seg] - exact[seg]).max()
+            assert err <= hop1 + hop2 + 1e-5, (a, b, err, hop1, hop2)
+        # the slow-tier residual is alive (error feedback engaged)
+        assert np.abs(np.asarray(ef)).max() > 0.0
+
+    def test_slow_tier_error_feedback_telescopes(self, hier_mesh):
+        """Repeated reduction of the SAME contributions: the hop-1 bias
+        telescopes through the single slow-tier EF site, so the cumulative
+        MEAN improves on the one-shot error and settles at the un-fed-back
+        hop-2 noise — bounded per bucket by the hop-2 HALF-quantum (the
+        multihop precedent asserts one_shot/2 instead, but with only
+        n_slices=2 slow-tier senders hop-1's share of the one-shot error
+        is small; the half-quantum bound is the tier-correct claim). A
+        codec that drops its residual keeps the full one-shot bias
+        (~2x the half-quantum here) at every horizon and fails both
+        assertions."""
+        plan = self._plan()
+        rng = np.random.RandomState(2)
+        contribs = rng.randn(8, self.S).astype(np.float32)
+        exact = contribs.sum(0)
+        inner = contribs.reshape(N_SLICES, N_INNER, self.S).sum(1)
+        f = _hier_reduce_fn(hier_mesh, plan)
+        ef = self._ef0(plan)
+        out1, _ = f(contribs, np.zeros_like(ef))
+        one_shot = np.abs(np.asarray(out1)[0] - exact).max()
+        cum = np.zeros(self.S)
+        steps = 12
+        for _ in range(steps):
+            out, ef = f(contribs, ef)
+            cum += np.asarray(out)[0]
+        mean = cum / steps
+        assert np.abs(mean - exact).max() < one_shot
+        for a, b in zip(plan.bounds, plan.bounds[1:]):
+            seg = slice(a, b)
+            hop1 = N_SLICES * (np.abs(inner[:, seg]).max() / 127.0) / 2
+            halfq2 = (np.abs(exact[seg]).max() + hop1) / 127.0 / 2
+            mean_err = np.abs(mean[seg] - exact[seg]).max()
+            assert mean_err <= halfq2 + 1e-5, (a, b, mean_err, halfq2)
+
+
+# ---------------------------------------------------------------------------
+# (d) Wire-byte accounting: the hierarchy's scaling property
+# ---------------------------------------------------------------------------
+
+
+class TestHierWireBytes:
+    """`hier_wire_bytes`: the two-tier byte formulas as code, across
+    (slices, per_slice) factorizations."""
+
+    def _plan(self, total=4096, bucket=1024):
+        # bucket sizes divisible by 16 -> zero padding at every world
+        # size used here, so the formulas are exact, not bounds
+        return build_bucket_plan({"a": np.zeros(total)},
+                                 bucket * 4 / (1024 ** 2))
+
+    def test_slow_tier_bytes_per_slice_independent_of_slice_count(self):
+        """THE property the hierarchy exists for: summed over a slice's
+        n_inner replicas, the DCN bytes are 2*S_padded per slice no matter
+        how many slices the fleet has — scaling out adds slices, not
+        per-slice slow-tier traffic. (Flat multihop's 2*S_padded rides
+        links that are ALL slow once the mesh spans pods.)"""
+        plan = self._plan()
+        s_padded = padded_total_size(plan, 8)
+        for n_shards, n_slices in ((4, 2), (8, 2), (8, 4)):
+            n_inner = n_shards // n_slices
+            split = hier_wire_bytes(plan, n_shards, n_slices)
+            assert split["dcn"] * n_inner == 2 * s_padded, \
+                (n_shards, n_slices)
+        # same n_inner, different slice count: identical per-replica split
+        assert hier_wire_bytes(plan, 4, 2) == hier_wire_bytes(plan, 8, 4)
+
+    def test_fast_tier_prices_as_flat_fp32_at_per_slice_degree(self):
+        plan = self._plan()
+        for n_shards, n_slices in ((4, 2), (8, 2), (8, 4)):
+            n_inner = n_shards // n_slices
+            split = hier_wire_bytes(plan, n_shards, n_slices)
+            if n_inner > 1:
+                assert split["ici"] == wire_bytes_per_replica(
+                    plan, "fp32", n_inner)
+            # the mode-table total is the tier sum
+            assert wire_bytes_per_replica(
+                plan, "int8_hier", n_shards, n_slices) == \
+                split["ici"] + split["dcn"]
+
+    def test_no_fast_tier_when_every_shard_is_its_own_slice(self):
+        plan = self._plan()
+        split = hier_wire_bytes(plan, 4, 4)  # n_inner == 1
+        assert split["ici"] == 0
+        assert split["dcn"] == 2 * padded_total_size(plan, 4)
+
+    def test_slices1_prices_as_flat_fp32(self):
+        plan = self._plan()
+        assert hier_wire_bytes(plan, 8, 1) == \
+            {"ici": wire_bytes_per_replica(plan, "fp32", 8), "dcn": 0}
+
+    def test_infeasible_factorizations_raise(self):
+        plan = self._plan()
+        with pytest.raises(ValueError, match="do not factor into"):
+            hier_wire_bytes(plan, 8, 3)
+        with pytest.raises(ValueError, match="n_slices must be >= 1"):
+            hier_wire_bytes(plan, 8, 0)
+
+
+# ---------------------------------------------------------------------------
+# Guards: the seams where a bad topology must fail loudly
+# ---------------------------------------------------------------------------
+
+
+class TestHierGuards:
+    def test_rejects_non_batch_slice_axis(self, mesh8):
+        with pytest.raises(ValueError, match="is not one of them"):
+            Trainer(LanguageModelingTask(), mesh8,
+                    TrainConfig(wire_dtype="int8_hier", slice_axis="model"))
+
+    def test_rejects_explicit_tp_composition(self, devices):
+        mesh2d = build_mesh(MeshSpec.parse("data=4,model=2"),
+                            devices=devices)
+        with pytest.raises(ValueError,
+                           match="does not compose with explicit TP"):
+            Trainer(LanguageModelingTask(), mesh2d,
+                    TrainConfig(wire_dtype="int8_hier", fsdp_explicit=True))
+
+    def test_hierspec_rejects_degenerate_topologies(self):
+        with pytest.raises(ValueError, match=">= 2 slices"):
+            HierSpec(slice_axis="slice", fast_axes=("data",),
+                     n_slices=1, n_inner=4)
+
+    def test_reduce_flat_requires_spec_and_residual(self):
+        plan = build_bucket_plan({"a": np.zeros(64)}, 0.0)
+        flat = np.zeros(64, np.float32)
+        with pytest.raises(ValueError, match="needs a HierSpec"):
+            reduce_flat(flat, plan, ("slice", "data"), 8, "int8_hier",
+                        residual=np.zeros(16, np.float32))
+        with pytest.raises(ValueError, match="error-feedback"):
+            reduce_flat(flat, plan, ("slice", "data"), 8, "int8_hier",
+                        hier=HSPEC)
+
+
+# ---------------------------------------------------------------------------
+# (e) The tier census: contract + rule mutations
+# ---------------------------------------------------------------------------
+
+
+def test_gsync_hier_contract_clean_and_tier_pure(devices):
+    """The ISSUE-16 acceptance contract, evaluated directly: the lowered
+    step is clean under the FULL rule suite and its census is tier-pure —
+    exactly n_buckets collectives per hop per tier, s8 (never f32) on
+    every cross-slice row."""
+    from distributed_pytorch_training_tpu.analysis.contracts import (
+        CONTRACT_MATRIX,
+    )
+    from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+        check_artifacts, evaluate_contract, expected_buckets,
+        grad_sync_census,
+    )
+
+    c = next(x for x in CONTRACT_MATRIX if x.name == "gsync_int8_hier")
+    a = evaluate_contract(c)
+    assert a.slice_shards == N_SLICES and a.hier_engaged
+    assert check_artifacts(a) == []
+    n_buckets = expected_buckets(a.total_grad_bytes,
+                                 float(c.config["bucket_cap_mb"]))
+    assert n_buckets > 1  # the cap really cuts — per-bucket counts bind
+    census = grad_sync_census(a.optimized_text, a.min_elements)
+    by = {}
+    for r in census["rows"]:
+        key = (a.collective_tier(r), r["op"])
+        by[key] = by.get(key, 0) + r["count"]
+    assert by == {("ici", "reduce-scatter"): n_buckets,
+                  ("ici", "all-gather"): n_buckets,
+                  ("dcn", "all-to-all"): n_buckets,
+                  ("dcn", "all-gather"): n_buckets}, by
+    wrows = grad_sync_census(a.wire_text, a.min_elements)["rows"]
+    dcn_rows = [r for r in wrows if a.collective_tier(r) == "dcn"]
+    assert dcn_rows and all("f32" not in r["dtypes"] for r in dcn_rows)
+    assert any("s8" in r["dtypes"] for r in dcn_rows)
+
+
+# --- synthetic-HLO mutation tests ------------------------------------------
+
+ICI_G = "{{0,1,2,3},{4,5,6,7}}"      # consecutive runs of n_inner
+DCN_G = "{{0,4},{1,5},{2,6},{3,7}}"  # stride-n_inner combs
+ALL_G = "{{0,1,2,3,4,5,6,7}}"        # spanning — flat traffic
+
+HEADER = ("HloModule jit_step, is_scheduled=true, "
+          "input_output_alias={ {0}: (0, {}, may-alias) }, "
+          "entry_computation_layout={(f32[64]{0})->f32[64]{0}}")
+
+
+def _coll(name, op, dt, n, groups, operand_n=None):
+    shp = dt + "[" + str(n) + "]{0}"
+    oshp = dt + "[" + str(operand_n if operand_n else n) + "]{0}"
+    return ("  %" + name + " = " + shp + " " + op + "(" + oshp +
+            " %p), dimensions={0}, replica_groups=" + groups)
+
+
+def _hier_lines():
+    """One bucket's full two-tier signature (16384-element slow part —
+    above the 8192 census floor)."""
+    return [
+        _coll("rs", "reduce-scatter", "f32", 16384, ICI_G, 65536),
+        _coll("a2a", "all-to-all", "s8", 16384, DCN_G),
+        _coll("agd", "all-gather", "s8", 16384, DCN_G, 8192),
+        _coll("agi", "all-gather", "f32", 65536, ICI_G, 16384),
+    ]
+
+
+def _hier_artifacts(body_lines, preopt_lines=None, **kw):
+    from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+        StepArtifacts,
+    )
+
+    def module(lines):
+        return HEADER + "\n\nENTRY %main {\n" + "\n".join(lines) + "\n}\n"
+
+    kw.setdefault("n_shards", 8)
+    kw.setdefault("slice_shards", 2)
+    kw.setdefault("min_elements", 8192)
+    kw.setdefault("config", dict(wire_dtype="int8_hier"))
+    # one huge bucket (no cap): part = 65536/1/4 = 16384 >= the floor, so
+    # the exact per-bucket count arm binds at n_buckets=1
+    kw.setdefault("total_grad_bytes", 65536 * 4)
+    return StepArtifacts(
+        name="synthetic", optimized_text=module(body_lines),
+        preopt_text=module(preopt_lines) if preopt_lines else None, **kw)
+
+
+def _run_rule(a, rule):
+    from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+        check_artifacts,
+    )
+
+    return check_artifacts(a, rules=[rule])
+
+
+class TestHierTierSignatureRule:
+    def test_full_signature_is_clean(self):
+        a = _hier_artifacts(_hier_lines(), preopt_lines=_hier_lines())
+        assert _run_rule(a, "hier-tier-signature") == []
+
+    def test_mutation_missing_slow_scatter_flags(self):
+        lines = [ln for ln in _hier_lines() if "%a2a" not in ln]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("hop 1" in f.message for f in fs), fs
+
+    def test_mutation_missing_slow_gather_flags(self):
+        lines = [ln for ln in _hier_lines() if "%agd" not in ln]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("hop 2" in f.message for f in fs), fs
+
+    def test_mutation_missing_fast_reduce_flags(self):
+        lines = [ln for ln in _hier_lines() if "%rs " not in ln]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("fast-tier reduce is missing" in f.message
+                   for f in fs), fs
+
+    def test_mutation_missing_fast_gather_flags(self):
+        lines = [ln for ln in _hier_lines() if "%agi" not in ln]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("never rebuilt" in f.message for f in fs), fs
+
+    def test_mutation_spanning_groups_flag_flat_traffic(self):
+        """A flat multihop mislabeled int8_hier: its groups span the whole
+        mesh — neither tier claims them."""
+        lines = _hier_lines() + [
+            _coll("flat", "all-to-all", "s8", 16384, ALL_G)]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("neither intra-slice nor cross-slice" in f.message
+                   for f in fs), fs
+
+    def test_mutation_extra_hop_breaks_per_bucket_count(self):
+        lines = _hier_lines() + [
+            _coll("a2a2", "all-to-all", "s8", 16384, DCN_G)]
+        fs = _run_rule(_hier_artifacts(lines), "hier-tier-signature")
+        assert any("expected exactly 1" in f.message for f in fs), fs
+
+    def test_mutation_f32_crossing_slices_flags(self):
+        """A decompressed hop-2 paying 4x on the slow links — the dtype
+        arm reads the pre-opt text like every wire rule."""
+        preopt = _hier_lines() + [
+            _coll("agf", "all-gather", "f32", 16384, DCN_G, 8192)]
+        fs = _run_rule(_hier_artifacts(_hier_lines(), preopt_lines=preopt),
+                       "hier-tier-signature")
+        assert any("CROSS-SLICE collective(s) carry f32" in f.message
+                   for f in fs), fs
+
+    def test_abstains_on_slices1_passthrough(self):
+        """slice_shards=1: the trainer resolved to the flat fp32 path —
+        no hier collective exists; every wire rule must abstain even on
+        text that would otherwise scream."""
+        garbage = [_coll("ar", "all-reduce", "f32", 16384, ALL_G)]
+        a = _hier_artifacts(garbage, preopt_lines=garbage, slice_shards=1)
+        assert not a.hier_engaged
+        for rule in ("hier-tier-signature", "no-fp32-wire",
+                     "compressed-wire"):
+            assert _run_rule(a, rule) == [], rule
+
+
+class TestNoFp32WireHierExemption:
+    def test_fast_tier_f32_is_exempt_when_hier_engaged(self):
+        """The intra-slice stage reduces in exact fp32 BY DESIGN — only
+        the ici tier is exempt from the no-fp32 promise."""
+        a = _hier_artifacts(_hier_lines(), preopt_lines=_hier_lines())
+        assert _run_rule(a, "no-fp32-wire") == []
+
+    def test_spanning_f32_reduction_still_flags(self):
+        preopt = _hier_lines() + [
+            _coll("ar", "all-reduce", "f32", 16384, ALL_G)]
+        fs = _run_rule(_hier_artifacts(_hier_lines(), preopt_lines=preopt),
+                       "no-fp32-wire")
+        assert fs and "f32" in fs[0].message

@@ -151,7 +151,8 @@ class TestHybridDcnMesh:
         import math
         assert math.prod(dcn[a] for a in AXIS_ORDER) == 4
         for a in AXIS_ORDER:
-            assert per[a] * dcn[a] == sizes[a]
+            # absent axes (the newer explicit `slice`) count as size 1
+            assert per[a] * dcn[a] == sizes.get(a, 1)
 
     def test_dcn_factors_spills_to_pipe_and_fsdp(self):
         from distributed_pytorch_training_tpu.parallel.mesh import dcn_factors

@@ -972,6 +972,7 @@ def test_chaos_cli_recovers_and_verifies_parity(tmp_path, capsys):
                for f in stats["flights"])
 
 
+@pytest.mark.slow  # ~10 s; narrow edge case — the recover/bidirectional chaos legs keep the CLI path fast
 def test_chaos_cli_fixed_world_capacity_return_is_harmless(tmp_path,
                                                            capsys):
     """A capacity_return fault in a FIXED-world schedule (no --elastic,
@@ -1029,11 +1030,17 @@ def test_chaos_cli_elastic_bidirectional_bitwise_parity(tmp_path, capsys):
                for f in stats["flights"])
 
 
+@pytest.mark.slow
 def test_chaos_cli_elastic_zero1_int8_ef_residuals(tmp_path, capsys):
     """The elastic reshard carries the FULL zero1 state across the resize
     — flat-padded moments AND the int8 wire's error-feedback residuals —
     and the post-resize segment still pins bitwise (the acceptance's
-    'EF residuals included')."""
+    'EF residuals included').
+
+    Slow tier (~39 s: a multi-process chaos run with two training
+    segments): the state-level half is pinned fast by test_elastic's
+    zero1-int8 reshard tests, and elastic chaos-CLI parity by the
+    bidirectional / fixed-world legs above."""
     rc, stats = _chaos_elastic(tmp_path, capsys,
                                "--layout", "zero1",
                                "--wire-dtype", "int8")

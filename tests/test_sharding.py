@@ -61,9 +61,13 @@ def test_shard_pytree_tp_splits(devices):
 
 
 def test_shard_batch_splits_leading_dim(mesh8):
+    from distributed_pytorch_training_tpu.parallel.mesh import BATCH_AXES
+
     batch = {"x": np.arange(32, dtype=np.float32).reshape(16, 2)}
     out = shard_batch(batch, mesh8)
-    assert out["x"].sharding.spec == P((DATA, "fsdp"), None)
+    # the batch rides EVERY batch axis (incl. the two-tier `slice` outer
+    # axis, size 1 on a single-slice mesh)
+    assert out["x"].sharding.spec == P(BATCH_AXES, None)
     assert out["x"].addressable_shards[0].data.shape == (2, 2)
     np.testing.assert_array_equal(np.asarray(out["x"]), batch["x"])
 

@@ -487,6 +487,75 @@ class TestMetricsEndpoint:
 
 
 # ---------------------------------------------------------------------------
+# two-tier wire rows end to end (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+class TestTwoTierWireEndToEnd:
+    def test_dcn_tier_row_survives_stream_aggregate_and_metrics(
+            self, tmp_path):
+        """One ``int8_hier`` emission produces the two tier rows —
+        (tier="ici", axis="data") and (tier="dcn", axis="slice") — and
+        the SAME rows survive every hop with zero schema change: the
+        per-rank JSONL stream, the fleet aggregate's (name, tier, axis)
+        rollup summed across ranks, and the /metrics render as one more
+        ``dpt_wire_bytes_total`` label value."""
+        import numpy as np
+
+        from distributed_pytorch_training_tpu.parallel.grad_sync import (
+            emit_wire_accounting, wire_bytes_split_for_config,
+        )
+
+        params = {"w": np.zeros((4096,), np.float32),
+                  "b": np.zeros((31,), np.float32)}
+        # in train.py/bench the trainer injects `slices` from the mesh
+        # (wire_accounting_inputs); here the test plays that role
+        cfg = {"wire_dtype": "int8_hier", "slices": 2}
+        expect = wire_bytes_split_for_config(params, cfg, 4)
+        assert expect["dcn"] > 0 and expect["ici"] > expect["dcn"]
+
+        paths, server, port = [], None, None
+        try:
+            for rank in (0, 1):
+                p = tmp_path / f"telemetry_rank{rank}.jsonl"
+                rec = telemetry.configure(str(p), gen=0, rank=rank)
+                if rank == 0:
+                    server = telemetry.MetricsServer(0, recorder=rec)
+                    port = server.start()
+                out = emit_wire_accounting(params, cfg, 4)
+                assert out["wire_bytes_dcn"] == expect["dcn"]
+                paths.append(p)
+            # hop 1: the per-rank stream carries BOTH tier rows
+            events, _bad = read_stream(str(paths[1]))
+            rows = {(e["tier"], e["axis"]): e["value"] for e in events
+                    if e.get("kind") == "counter"
+                    and e.get("name") == "wire_bytes_per_replica"}
+            assert rows == {("ici", "data"): expect["ici"],
+                            ("dcn", "slice"): expect["dcn"]}
+            # hop 2: the fleet rollup keys (name, tier, axis) and sums
+            # across ranks — the dcn tier is just one more row
+            agg = aggregate_streams(paths)
+            wire = {(w["name"], w["tier"], w["axis"]): w["total"]
+                    for w in agg["wire"]}
+            assert wire[("wire_bytes_per_replica", "dcn", "slice")] \
+                == 2 * expect["dcn"]
+            assert wire[("wire_bytes_per_replica", "ici", "data")] \
+                == 2 * expect["ici"]
+            # hop 3: /metrics renders it (rank 0's server observed only
+            # rank 0's emission — per-rank scoping holds)
+            _, body = _scrape(port)
+            assert ('dpt_wire_bytes_total{name="wire_bytes_per_replica"'
+                    ',tier="dcn",axis="slice"} '
+                    + format(float(expect["dcn"]), "g")) in body
+            assert ('dpt_wire_bytes_total{name="wire_bytes_per_replica"'
+                    ',tier="ici",axis="data"} '
+                    + format(float(expect["ici"]), "g")) in body
+        finally:
+            if server is not None:
+                server.stop()
+
+
+# ---------------------------------------------------------------------------
 # StreamFollower: tail -f and the fleet's live progress probe
 # ---------------------------------------------------------------------------
 

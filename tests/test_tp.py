@@ -8,8 +8,11 @@ int8_multihop converges with EF present; (b) at-rest census — params AND
 both AdamW moments flat-sharded 1/(N*M) for every TP-split leaf (the
 model-major layout, parallel/sharding.tp_flat_leaf); (c) HLO census —
 exactly the megatron model-axis psum budget (one per residual join
-forward + its backward mirror, +2 for the vocab-parallel embedding), one
-model-axis logits gather, one DATA-axis gather and one scatter per layer
+forward + its backward mirror, +2 for the vocab-parallel embedding, +2
+for the parallel-vocab CE's batch-shaped stat collectives when they
+clear the floor — ISSUE 16), ZERO model-axis gathers (the vocab-scale
+logits gather is the regression the parallel-vocab cross-entropy
+removed), one DATA-axis gather and one scatter per layer
 group over the TP-LOCAL plan, and ZERO gradient-sized all-reduce off the
 model axis (floor-aware, per-group); (d) the `fsdp_tp` contracts evaluate
 clean in the default `analysis check` gate, and each new rule flags a
@@ -122,6 +125,7 @@ def _assert_params_close(ref, got, **tol):
 # --- fp32 parity vs the 1-D replicated baseline -----------------------------
 
 
+@pytest.mark.slow  # ~11 s; the adamw+clip 20-step leg stays fast and is the stricter parity
 def test_tp_fsdp_sgd_20step_matches_replicated(mesh_1d, mesh_tp):
     """THE acceptance parity: same global batch, same seed — the 2-D
     TP x FSDP trajectory matches the replicated 1-D baseline at
@@ -192,6 +196,52 @@ def test_tp_eval_step_matches_replicated_eval(mesh_1d, mesh_tp):
     m_tp = t_tp._eval_step(s_tp, _batch(mesh_tp))
     np.testing.assert_allclose(float(m_rep["loss_sum"]),
                                float(m_tp["loss_sum"]), rtol=1e-5)
+
+
+def test_tp_parallel_ce_matches_gathered_fp32():
+    """The parallel-vocab CE pin (ISSUE 16): loss, gradient and the
+    correctness mask computed from LOCAL logit columns (2 batch-shaped
+    model-axis stats) match the gathered-logits optax form in fp32, and
+    both shards return the identical replicated value."""
+    import optax
+
+    from distributed_pytorch_training_tpu.parallel.collectives import (
+        TpShardedLogits, tp_parallel_cross_entropy,
+    )
+
+    rng = np.random.RandomState(0)
+    full = (rng.randn(4, 7, VOCAB) * 4.0).astype(np.float32)
+    tgt = rng.randint(0, VOCAB, (4, 7)).astype(np.int32)
+    half = VOCAB // 2
+
+    def per_shard(local):
+        return tp_parallel_cross_entropy(
+            TpShardedLogits(local, "m", half, VOCAB), jnp.asarray(tgt))
+
+    locals_ = jnp.stack([full[..., :half], full[..., half:]])
+    ce, correct = jax.vmap(per_shard, axis_name="m")(locals_)
+    ref = optax.softmax_cross_entropy_with_integer_labels(
+        jnp.asarray(full), jnp.asarray(tgt))
+    np.testing.assert_array_equal(np.asarray(ce[0]), np.asarray(ce[1]))
+    np.testing.assert_allclose(np.asarray(ce[0]), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(correct[0]), np.asarray(jnp.argmax(full, -1) == tgt))
+
+    # gradient parity: d(sum ce)/d(logits) — softmax minus one-hot,
+    # each shard holding exactly its own columns of the gathered grad
+    g_sharded = jax.vmap(
+        lambda l: jax.grad(lambda x: per_shard(x)[0].sum())(l),
+        axis_name="m")(locals_)
+    g_ref = jax.grad(
+        lambda x: optax.softmax_cross_entropy_with_integer_labels(
+            x, jnp.asarray(tgt)).sum())(jnp.asarray(full))
+    np.testing.assert_allclose(np.asarray(g_sharded[0]),
+                               np.asarray(g_ref[..., :half]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_sharded[1]),
+                               np.asarray(g_ref[..., half:]),
+                               rtol=1e-5, atol=1e-6)
 
 
 # --- at-rest census ---------------------------------------------------------
@@ -301,8 +351,11 @@ def _axis_counts(text, floor, n_batch, n_model):
 def test_tp_census_model_psums_and_data_only_wire(mesh_tp, wire):
     """The acceptance census: exactly 4*depth + 2 model-axis psums (one
     per residual join forward + backward mirror, + the vocab-parallel
-    embedding pair), ONE model-axis gather (logits), one DATA-axis gather
-    and one scatter per layer group over the TP-LOCAL plan, and zero
+    embedding pair) + 2 parallel-vocab CE stat collectives (the pmax +
+    the stacked sumexp/target psum — batch-shaped (rows, S-1, 2) = 240
+    elements here, over the 64 floor), ZERO model-axis gathers (the
+    vocab-scale logits gather is gone), one DATA-axis gather and one
+    scatter per layer group over the TP-LOCAL plan, and zero
     gradient-sized all-reduce off the model axis — floor-aware,
     per-group."""
     floor = 64
@@ -311,8 +364,8 @@ def test_tp_census_model_psums_and_data_only_wire(mesh_tp, wire):
         s, _batch(mesh_tp), jax.random.PRNGKey(1)).compile().as_text()
     counts = _axis_counts(text, floor, n_batch=2, n_model=2)
 
-    assert counts.get(("all-reduce", "model"), 0) == 4 * DEPTH + 2
-    assert counts.get(("all-gather", "model"), 0) == 1  # the logits gather
+    assert counts.get(("all-reduce", "model"), 0) == 4 * DEPTH + 2 + 2
+    assert counts.get(("all-gather", "model"), 0) == 0  # no logits gather
     assert counts.get(("all-reduce", "data"), 0) == 0
     assert counts.get(("all-reduce", "all"), 0) == 0
 
@@ -354,6 +407,7 @@ def test_tp_layer_plan_is_local(mesh_tp):
 # --- analysis contracts + mutation tests ------------------------------------
 
 
+@pytest.mark.slow  # ~8 s; strictly redundant with the full contract-matrix gate in test_analysis_cli
 def test_fsdp_tp_contracts_pass_without_relaxation():
     """The fsdp_tp contracts evaluate clean on their OWN 2-D mesh
     (Contract.mesh_spec) with the trainer-derived psum budget — and the
@@ -370,12 +424,18 @@ def test_fsdp_tp_contracts_pass_without_relaxation():
         a = evaluate_contract(get_contract(name))
         assert a.model_shards == 2
         assert a.tp_expected_psums == 4 * DEPTH + 2
-        assert a.tp_expected_model_gathers == 1
+        assert a.tp_expected_model_gathers == 0  # the gather-regression pin
+        # the CE stats really carry a nonzero floor-aware budget: 4 rows
+        # per data shard (2/device x 8 devices / 4 shards) x 15 positions
+        # x width 2 — over the contract's 64 floor, so the rule binds at
+        # +2 (not vacuously at +0)
+        assert a.tp_ce_stat_elements == 2 * 4 * (16 - 1)
+        assert a.tp_ce_stat_elements >= a.min_elements
         findings = check_artifacts(a)
         assert not findings, (name, [f.message for f in findings])
 
 
-def _synthetic_tp_text(model_ars=10, model_gathers=1, data_gathers=5,
+def _synthetic_tp_text(model_ars=10, model_gathers=0, data_gathers=5,
                        data_scatters=5, extra=""):
     """Synthetic optimized-HLO text for the mutation tests: 4 batch shards
     x 2 model shards (8 devices, model minor)."""
@@ -408,7 +468,7 @@ def _tp_artifacts(text, **overrides):
 
     kw = dict(name="synthetic", optimized_text=text,
               config={"fsdp_explicit": True}, n_shards=4, model_shards=2,
-              tp_expected_psums=10, tp_expected_model_gathers=1,
+              tp_expected_psums=10, tp_expected_model_gathers=0,
               min_elements=128,
               layer_group_padded_sizes=(4096, 4096, 4096, 4096, 4096))
     kw.update(overrides)
@@ -441,10 +501,26 @@ class TestTpRuleMutations:
         assert self._check(_synthetic_tp_text(model_ars=11),
                            "tp-psum-signature")
 
-    def test_missing_logits_gather_flagged(self):
-        f = self._check(_synthetic_tp_text(model_gathers=0),
+    def test_model_gather_regression_flagged(self):
+        # the vocab-scale logits gather the parallel-vocab CE removed:
+        # its reappearance is the regression the rule pins at zero
+        f = self._check(_synthetic_tp_text(model_gathers=1),
                         "tp-psum-signature")
-        assert f and "model-axis all-gather" in f[0].message
+        assert f and "regression it replaced" in f[0].message
+
+    def test_ce_stats_raise_the_psum_budget_when_over_floor(self):
+        # with batch-shaped CE stats over the floor the budget is 10+2:
+        # 12 psums pass, the bare structural 10 now FAILS (a dropped CE
+        # stat collective is a lost loss reduction, not noise)
+        assert not self._check(_synthetic_tp_text(model_ars=12),
+                               "tp-psum-signature",
+                               tp_ce_stat_elements=2048)
+        f = self._check(_synthetic_tp_text(model_ars=10),
+                        "tp-psum-signature", tp_ce_stat_elements=2048)
+        assert f and "expected exactly 12" in f[0].message
+        # under the floor the stats are census-invisible: budget stays 10
+        assert not self._check(_synthetic_tp_text(model_ars=10),
+                               "tp-psum-signature", tp_ce_stat_elements=64)
 
     def test_missing_budget_is_itself_a_finding(self):
         f = self._check(_synthetic_tp_text(), "tp-psum-signature",
@@ -553,7 +629,11 @@ def test_tp_data_axis_bytes_drop_by_1_over_m():
 def test_tp_psum_bytes_per_step_formula():
     b = tp_psum_bytes_per_step(32, 2, 4, 16, 2, tp_vocab=True,
                                padded_vocab=64)
-    assert b == 8 * (4 * 16 * 32) * 10 + 4 * 4 * 16 * 64
+    # the vocab head's wire is the two (B, S, 2) CE stat all-reduces
+    # (32 bytes x B x S) — NOT the 4 x B x S x padded_vocab logits
+    # gather the parallel-vocab CE replaced
+    assert b == 8 * (4 * 16 * 32) * 10 + 32 * 4 * 16
+    assert b < 8 * (4 * 16 * 32) * 10 + 4 * 4 * 16 * 64  # strictly shrank
     assert tp_psum_bytes_per_step(32, 2, 4, 16, 1) == 0
     no_vocab = tp_psum_bytes_per_step(32, 2, 4, 16, 2)
     assert no_vocab == 8 * (4 * 16 * 32) * 8
@@ -685,6 +765,7 @@ def test_validate_mesh_rejects_model_axis_for_ruleless_models(devices):
 # --- checkpoint -------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~12 s; sharded-layout checkpoint roundtrip stays fast via the richer fsdp flat-params+EF leg
 def test_tp_checkpoint_roundtrip_bitwise(mesh_tp, tmp_path):
     """The model-major at-rest layout round-trips through the async
     manifest-verified checkpoint path bit-exactly, and the restored run

@@ -219,7 +219,19 @@ def _run(args, guard):
     else:
         Deathwatch.arm(log=log_main)
     set_seed(args.seed, ctx.process_index)  # seed+rank rule, ref :76-78/:319
-    mesh = build_mesh(MeshSpec.parse(args.mesh))
+    mesh_spec = MeshSpec.parse(args.mesh)
+    if args.slices > 1:
+        # --slices folds the slow-tier/outer axis into the mesh spec; an
+        # explicit slice=... in --mesh must agree (two sources of truth
+        # silently disagreeing is how wrong topologies ship)
+        import dataclasses as _dc
+        if mesh_spec.slice not in (1, args.slices):
+            raise ValueError(
+                f"--slices {args.slices} conflicts with --mesh "
+                f"{args.mesh!r} (slice={mesh_spec.slice}); set the slice "
+                "factor in one place")
+        mesh_spec = _dc.replace(mesh_spec, slice=args.slices)
+    mesh = build_mesh(mesh_spec)
     n_batch_shards = batch_shard_count(mesh)
     global_batch = args.batch_size * n_batch_shards
     # the /metrics world-size gauge (elastic relaunches land at different
@@ -514,6 +526,7 @@ def _run(args, guard):
                                   fsdp_explicit=args.fsdp_explicit,
                                   bucket_cap_mb=args.bucket_cap_mb,
                                   wire_dtype=args.wire_dtype,
+                                  slice_axis=args.slice_axis,
                                   overlap_grad_sync=not
                                   args.no_overlap_grad_sync,
                                   fused_quantize={"auto": None, "on": True,
@@ -552,6 +565,13 @@ def _run(args, guard):
                  f"{args.bucket_cap_mb or 'inf (one bucket)'}, "
                  f"wire={args.wire_dtype}, overlap="
                  f"{'off' if args.no_overlap_grad_sync else 'on'}")
+    if trainer._hier is not None:
+        h = trainer._hier
+        log_main(f"Two-tier wire (int8_hier): {h.n_slices} slices x "
+                 f"{h.n_inner} replicas/slice — exact fp32 reduce-scatter "
+                 f"inside the slice, s8+EF exchange across "
+                 f"{h.slice_axis!r} (~2 B/element per slice on the slow "
+                 "tier, slice-count independent)")
 
     if not args.no_telemetry:
         # anomaly watchdog fed by train_epoch's host-side timings + the
@@ -612,7 +632,9 @@ def _run(args, guard):
         acct_params, acct_cfg = trainer.wire_accounting_inputs(
             state, dict(wire_dtype=args.wire_dtype,
                         bucket_cap_mb=args.bucket_cap_mb,
-                        fsdp_explicit=args.fsdp_explicit),
+                        fsdp_explicit=args.fsdp_explicit,
+                        slices=(trainer._hier.n_slices
+                                if trainer._hier is not None else 1)),
             global_batch, seq_len if is_lm else 0)
         emit_wire_accounting(acct_params, acct_cfg, n_batch_shards)
 
