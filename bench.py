@@ -190,6 +190,39 @@ EXTRA_CONFIGS = (
      dict(per_device_batch=2, seq_len=1024, steps=6,
           grad_sync=dict(fsdp_explicit=True),
           mesh_spec="data=-1,model=2")),
+    # Serving offered-load arms (ISSUE 17): latency rows, not train
+    # throughput — the `serving` marker routes them past measure_config to
+    # run_serving (experiments/harness measure_serving /
+    # measure_serving_continuous), and their value is tokens/sec. The
+    # iteration/token pair at the SAME offered load and shapes is the
+    # continuous-batching A/B the acceptance gate reads: token-granular
+    # (slot pool + paged KV, requests join/leave between tokens) must beat
+    # iteration-granular (form batch -> decode to completion -> repeat) on
+    # BOTH tok/s and p99 — the p99 win is the point, a long request no
+    # longer convoys the short ones behind it. The int8 arm adds the
+    # paged-vs-dense KV byte ratio (>= 3x is the HBM claim); the fleet arm
+    # runs 2 router-fronted replicas and KILLS one mid-run — every request
+    # must still complete (seed-pinned resubmit) with zero recompiles.
+    # mixed_want gives every request its own decode length (1..max_new,
+    # seed-pinned identically on both arms) — the serving-shaped workload
+    # where convoying actually hurts: the iteration arm must decode the
+    # full max_new for the whole batch and only the wanted tokens count.
+    ("serving_iter_gpt2", "gpt2_124m", 300,
+     dict(serving=dict(kind="iteration", n_requests=24, offered_rps=16.0,
+                       buckets=(8, 16), rows=8, max_new_tokens=8,
+                       mixed_want=True))),
+    ("serving_token_gpt2", "gpt2_124m", 300,
+     dict(serving=dict(kind="token", n_requests=24, offered_rps=16.0,
+                       buckets=(8, 16), rows=8, max_new_tokens=8,
+                       mixed_want=True))),
+    ("serving_token_int8", "gpt2_124m", 300,
+     dict(serving=dict(kind="token", n_requests=24, offered_rps=16.0,
+                       buckets=(8, 16), rows=8, max_new_tokens=8,
+                       mixed_want=True, kv_dtype="int8", page_size=8))),
+    ("serving_fleet2", "gpt2_124m", 360,
+     dict(serving=dict(kind="token", n_requests=24, offered_rps=16.0,
+                       buckets=(8, 16), rows=8, max_new_tokens=8,
+                       mixed_want=True, replicas=2, kill_replica=True))),
 )
 
 # Probe script run in a disposable subprocess: succeeds iff the backend can
@@ -901,7 +934,7 @@ def _bench(args):
         deathwatch = _start_relay_deathwatch(assume_tunneled=True)
 
     from distributed_pytorch_training_tpu.experiments.harness import (
-        measure_config,
+        measure_config, measure_serving, measure_serving_continuous,
     )
 
     n_chips = jax.device_count()
@@ -950,6 +983,48 @@ def _bench(args):
             why = (r.get("contracts") or {}).get(
                 "error", "no contracts recorded")
             _log(f"bench: {name} contract checker did not run: {why}")
+        return r
+
+    def run_serving(label, name, **skw):
+        """One serving offered-load row (the `serving` marker arms): routes
+        to measure_serving (iteration-granular) or
+        measure_serving_continuous (token-granular slot pool) and logs the
+        latency/throughput shape a serving row has instead of run()'s
+        samples/sec/chip. recompiles_after_warmup != 0 is loud here and a
+        hard exit in `serving bench` — bench records it as a measurement."""
+        kind = skw.pop("kind", "token")
+        _log(f"bench: === {label} serving/{kind} {skw} === "
+             f"({time_left():.0f}s left)")
+        t0 = time.perf_counter()
+        if kind == "iteration":
+            r = measure_serving(name, **skw)
+        else:
+            r = measure_serving_continuous(name, **skw)
+        r["wall_s"] = round(time.perf_counter() - t0, 1)
+        contract = (r.get("contracts") or {}).get("pass")
+        c_str = {True: "ok", False: "VIOLATED", None: "unchecked"}[contract]
+        _log(f"bench: {label} done in {r['wall_s']:.1f}s: "
+             f"p50={r['p50_ms']}ms p99={r['p99_ms']}ms "
+             f"{r.get('tokens_per_sec', 0.0):.1f} tok/s, "
+             f"recompiles_after_warmup={r['recompiles_after_warmup']}, "
+             f"contracts={c_str}")
+        if r.get("ttft_p99_ms") is not None:
+            _log(f"bench: {label} ttft p50={r['ttft_p50_ms']}ms "
+                 f"p99={r['ttft_p99_ms']}ms")
+        if r.get("kv_bytes_ratio") is not None:
+            _log(f"bench: {label} paged KV {r['paged_kv_bytes']}B vs dense "
+                 f"{r['dense_kv_bytes']}B ({r['kv_bytes_ratio']}x)")
+        for rep, stats in (r.get("per_replica") or {}).items():
+            _log(f"bench: {label} replica {rep}: served={stats['served']} "
+                 f"alive={stats['alive']} p50={stats['p50_ms']}ms "
+                 f"p99={stats['p99_ms']}ms")
+        if r["recompiles_after_warmup"]:
+            _log(f"bench: {label} RECOMPILED after warmup "
+                 f"({r['recompiles_after_warmup']}x) — the zero-recompile "
+                 "census is broken")
+        if contract is False:
+            _log(f"bench: {label} CONTRACT VIOLATIONS: "
+                 f"{r['contracts']['violations']}")
         return r
 
     def result_dict(headline, fp32, extras, skipped):
@@ -1035,15 +1110,23 @@ def _bench(args):
         to the labels that actually never ran (_resolve_provisional_marker)
         instead of committing `configs_skipped: []` for a truncated chunk."""
         first = extras[0]
-        prec = "bf16" if first.get("bf16") else "fp32"
+        if str(first.get("mode", "")).startswith("serving"):
+            # serving rows are latency rows: tokens/sec, no MFU
+            metric = f"{first['label']}_serving_tokens_per_sec"
+            value, unit = first.get("tokens_per_sec", 0.0), "tokens/sec"
+        else:
+            prec = "bf16" if first.get("bf16") else "fp32"
+            metric = f"{first['label']}_train_throughput_{prec}"
+            value = first["samples_per_sec_chip"]
+            unit = "samples/sec/chip"
         return {
-            "metric": f"{first['label']}_train_throughput_{prec}",
-            "value": first["samples_per_sec_chip"],
-            "unit": "samples/sec/chip",
+            "metric": metric,
+            "value": value,
+            "unit": unit,
             "vs_baseline": None,
             "n_chips": n_chips,
             "chip": devices[0].device_kind,
-            "mfu_pct": first["mfu_pct"],
+            "mfu_pct": first.get("mfu_pct"),
             "only": sorted(only),
             "configs": extras,
             "configs_skipped": (skipped + ["<provisional>"] if provisional
@@ -1091,8 +1174,11 @@ def _bench(args):
                 skipped.append(label)
                 continue
             try:
-                # bf16 by default; a config may override (fp32 arms)
-                r = run(name, **{"bf16": True, **kw})
+                if "serving" in kw:
+                    r = run_serving(label, name, **dict(kw["serving"]))
+                else:
+                    # bf16 by default; a config may override (fp32 arms)
+                    r = run(name, **{"bf16": True, **kw})
                 r["label"] = label
                 extras.append(r)
                 # Flush a provisional line after EVERY completed config so a
@@ -1109,6 +1195,23 @@ def _bench(args):
             except Exception:
                 _log(f"bench: extra config {label} failed (continuing):\n"
                      + traceback.format_exc())
+        by_label = {r.get("label"): r for r in extras}
+        s_it = by_label.get("serving_iter_gpt2")
+        s_tok = by_label.get("serving_token_gpt2")
+        if s_it and s_tok:
+            # the continuous-batching claim as a measured sentence: same
+            # offered load, same shapes, token-granular vs iteration-
+            # granular (the history rows carry the full distributions)
+            win = (s_tok.get("tokens_per_sec", 0.0)
+                   > s_it.get("tokens_per_sec", 0.0)
+                   and s_tok["p99_ms"] < s_it["p99_ms"])
+            _log("bench: serving A/B: token-granular "
+                 f"{s_tok.get('tokens_per_sec', 0.0):.1f} tok/s "
+                 f"p99={s_tok['p99_ms']}ms vs iteration-granular "
+                 f"{s_it.get('tokens_per_sec', 0.0):.1f} tok/s "
+                 f"p99={s_it['p99_ms']}ms -> "
+                 + ("token-granular wins both"
+                    if win else "NO WIN — continuous batching regressed"))
         if skipped:
             _log(f"bench: skipped {skipped} — remaining soft budget "
                  f"({time_left():.0f}s of the {args.deadline}s watchdog) is "

@@ -339,11 +339,13 @@ def check_host_sync_in_step(ctx: FileContext) -> List[Finding]:
                             "no-host-sync-in-step", "step path", "per-step")
 
 
-# The serving decode hot loop's home and function names (serving/engine.py
-# `generate`, plus anything a refactor names *_decode_loop). One host fetch
-# per BATCH is the design (after the last step, in serve_tokens); a fetch
-# inside the loop stalls the device once per generated TOKEN.
-_DECODE_LOOP_FILE = "serving/engine.py"
+# The serving decode hot loops' homes and function names (serving/engine.py
+# `generate`, serving/continuous.py `_step_decode_loop` — the continuous
+# scheduler's shared-pool sibling — plus anything a refactor names
+# *_decode_loop). One host fetch per BATCH is the design (after the last
+# step, in serve_tokens / _complete_finished); a fetch inside the loop
+# stalls the device once per generated TOKEN, for EVERY slot in the pool.
+_DECODE_LOOP_FILES = ("serving/engine.py", "serving/continuous.py")
 
 
 def _is_decode_loop_name(name: str) -> bool:
@@ -351,15 +353,16 @@ def _is_decode_loop_name(name: str) -> bool:
 
 
 @rule("no-host-sync-in-decode", "ast",
-      "no .item()/float()/device_get syncs inside the serving decode loop "
-      "(serving/engine.py generate)",
+      "no .item()/float()/device_get syncs inside the serving decode loops "
+      "(serving/engine.py generate, serving/continuous.py "
+      "_step_decode_loop)",
       "the decode loop runs one compiled step per generated token with "
       "every chained value (token, positions, cache) staying on device; "
       "a host fetch creeping in serializes the device per TOKEN — the "
       "training loop's .item() anti-pattern, multiplied by max_new_tokens "
       "per request.")
 def check_host_sync_in_decode(ctx: FileContext) -> List[Finding]:
-    if not ctx.relpath.endswith(_DECODE_LOOP_FILE):
+    if not any(ctx.relpath.endswith(f) for f in _DECODE_LOOP_FILES):
         return []
     loops = [n for n in ast.walk(ctx.tree)
              if isinstance(n, ast.FunctionDef)

@@ -146,7 +146,10 @@ class Contract:
     (`hlo_rules._tiny_lm_setup`); "serving" lowers the inference engine's
     KV-cache decode step (`hlo_rules.evaluate_serving_contract`) — the
     decode-step contract of serving/ (ISSUE 10), run by the same tier-1
-    ``analysis check`` gate; "elastic" lowers the SAME train step twice at
+    ``analysis check`` gate; "serving_paged" lowers the SlotEngine's
+    shared paged decode step (`hlo_rules.evaluate_paged_serving_contract`,
+    ISSUE 17) — the continuous-batching page-pool-donation contract;
+    "elastic" lowers the SAME train step twice at
     the target world — once from a clean state, once from a state
     resharded by resilience.elastic (down N->M for ``elastic_reshard``,
     UP M->N for ``elastic_grow``) — and pins the censuses equal
@@ -296,6 +299,23 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "in place (serving/engine.py lower_decode)",
              config=dict(serving_decode=True, donate_state=True),
              kind="serving"),
+    # The paged continuous-batching contract (ISSUE 17): the SlotEngine's
+    # SHARED decode step — one program serving every slot at once — must
+    # carry no host transfers and must alias the ENTIRE page pool in
+    # place: paged-pool-donated counts the alias table against the pool's
+    # leaf census (paged_cache_leaves). Pinned on the int8 arm because it
+    # has the most leaves to drop (k/v codes + k/v scales per block); a
+    # missing scale buffer is invisible to the presence-only donation
+    # rule but doubles int8 pool traffic on every generated token. The
+    # zero-recompile-across-joins/leaves half is runtime behavior, pinned
+    # by tests/test_continuous.py and `serving bench --continuous`.
+    Contract("serving_paged",
+             "paged int8 continuous-batching decode: no host transfers, "
+             "full page pool (codes + scales) donated in place "
+             "(serving/continuous.py lower_paged_decode)",
+             config=dict(serving_paged=True, donate_state=True,
+                         paged_kv_dtype="int8"),
+             kind="serving_paged"),
     # The elastic-reshard contract (ISSUE 11): a state resharded N -> M by
     # resilience.elastic must lower to EXACTLY the HLO census a clean-at-M
     # state lowers to — a reshard that lands a leaf replicated (or in any

@@ -1066,6 +1066,34 @@ def check_decode_cache_donated(a: StepArtifacts) -> List[Finding]:
     return []
 
 
+@rule("paged-pool-donated", "hlo",
+      "the paged decode step aliases EVERY page-pool buffer in place",
+      "the slot engine's shared decode step donates the whole paged KV "
+      "pool (serving/continuous.py lower_paged_decode): 2 layer-stacked "
+      "buffers fp32 (k/v pages), 4 int8 (codes + scales). Any "
+      "pool leaf out of the alias table is copied on EVERY generated "
+      "token for EVERY slot — and the copy is pool-sized, not slot-sized, "
+      "so the tax scales with the whole fleet's cache, exactly what "
+      "paging exists to avoid. The presence-only donation rule cannot "
+      "see one dropped leaf; this rule counts the table against the "
+      "pool's leaf census (``paged_cache_leaves``).")
+def check_paged_pool_donated(a: StepArtifacts) -> List[Finding]:
+    if not a.config.get("serving_paged"):
+        return []
+    expect = int(a.config.get("paged_cache_leaves", 0))
+    m = re.search(r"input_output_alias=\{(.*?\))\s*\}", a.optimized_text,
+                  re.DOTALL)
+    entries = len(_ALIAS_ENTRY_RE.findall(m.group(1))) if m else 0
+    if entries < expect:
+        return [Finding(
+            "paged-pool-donated",
+            f"paged decode step aliases {entries} of the >= {expect} "
+            "pool buffers (k/v pages + int8 scales + slot control) — the "
+            "un-aliased ones are copied pool-wide on every generated "
+            "token", a.name)]
+    return []
+
+
 @rule("elastic-reshard-census", "hlo",
       "a resharded N->M state's train step carries exactly the clean-at-M "
       "collective census",
@@ -1160,7 +1188,8 @@ def check_dp_sync_present(a: StepArtifacts) -> List[Finding]:
             # guard is about the TRAIN step's reducer, not a scoping knob
             # to relax: an inference forward with an all-reduce would be
             # the bug, not the absence of one
-            or a.config.get("serving_decode")):
+            or a.config.get("serving_decode")
+            or a.config.get("serving_paged")):
         # grad-accum keeps sync inside a scan; count it only on the plain arm
         return []
     census = weight_update_census(a.optimized_text, a.min_elements)
@@ -1263,6 +1292,37 @@ def serving_artifacts(engine, bucket: int,
     )
 
 
+def paged_serving_artifacts(engine, name: str = "serving_paged"
+                            ) -> StepArtifacts:
+    """StepArtifacts of a SlotEngine's shared paged decode step — the
+    continuous-batching sibling of `serving_artifacts`. ``paged_cache_leaves``
+    is the page pool's donated-leaf census — the pool is stacked across
+    layers (models/layers.py PagedKV), so it is 2 buffers fp32 (k/v
+    pages), 4 int8 (k/v codes + k/v scales), regardless of depth — and
+    `paged-pool-donated` demands the WHOLE pool aliased, scales included:
+    a dropped scale buffer silently doubles int8 pool traffic."""
+    import jax
+
+    from ..parallel.mesh import batch_shard_count
+
+    lowered = engine.lower_paged_decode()
+    optimized = lowered.compile().as_text()
+    try:
+        preopt = preopt_hlo_text(lowered)
+    except Exception:  # pragma: no cover - backend without HLO dialect
+        preopt = None
+    pool_leaves = 4 if engine.config.kv_dtype == "int8" else 2
+    return StepArtifacts(
+        name=name,
+        optimized_text=optimized,
+        preopt_text=preopt,
+        config={"serving_paged": True, "donate_state": True,
+                "paged_cache_leaves": pool_leaves},
+        n_shards=batch_shard_count(engine.mesh),
+        backend=jax.default_backend(),
+    )
+
+
 def evaluate_serving_contract(contract: Contract,
                               mesh=None) -> StepArtifacts:
     """Lower the tiny serving engine's decode step and snapshot artifacts —
@@ -1296,6 +1356,46 @@ def evaluate_serving_contract(contract: Contract,
         artifacts, config={**artifacts.config, **contract.config,
                            "decode_cache_leaves":
                            artifacts.config["decode_cache_leaves"]},
+        min_elements=contract.min_elements)
+
+
+def evaluate_paged_serving_contract(contract: Contract,
+                                    mesh=None) -> StepArtifacts:
+    """The ``kind="serving_paged"`` evaluator: build the tiny contract
+    model behind the REAL continuous-batching path (serving/continuous.py
+    SlotEngine), lower the shared paged decode step, and snapshot its
+    artifacts. The matrix entry pins the int8 arm
+    (``paged_kv_dtype="int8"``) because that is the path with the most
+    leaves to drop from the alias table — codes AND scales per block —
+    and the fp32 arm's table is a strict subset of it."""
+    import jax
+    import numpy as np
+
+    from ..models.gpt2 import GPT2LMHead
+    from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
+    from ..serving.continuous import SlotEngine
+    from ..serving.paged import PagedServeConfig
+
+    if mesh is None:
+        mesh = build_mesh(MeshSpec(), devices=jax.devices())
+    n_shards = batch_shard_count(mesh)
+    if n_shards < contract.min_shards:
+        raise ValueError(
+            f"contract {contract.name!r} needs >= {contract.min_shards} "
+            f"batch shards (got {n_shards})")
+    model = GPT2LMHead(vocab_size=64, hidden_dim=32, depth=2, num_heads=2,
+                       max_position=32)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+                        train=False)["params"]
+    cfg = PagedServeConfig(
+        buckets=(8,), rows=4, max_new_tokens=4, page_size=4,
+        kv_dtype=contract.config.get("paged_kv_dtype", "fp32"))
+    engine = SlotEngine(model, mesh, cfg, params)
+    artifacts = paged_serving_artifacts(engine, name=contract.name)
+    return dataclasses.replace(
+        artifacts, config={**artifacts.config, **contract.config,
+                           "paged_cache_leaves":
+                           artifacts.config["paged_cache_leaves"]},
         min_elements=contract.min_elements)
 
 
@@ -1372,8 +1472,10 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     evaluating the contract would vacuously pass; the caller decides
     whether that is a skip or an error). ``kind="serving"`` contracts
     route to `evaluate_serving_contract` (the inference engine's decode
-    step instead of a Trainer step); ``kind="elastic"`` to
-    `evaluate_elastic_contract` (the resharded-vs-clean census pin).
+    step instead of a Trainer step); ``kind="serving_paged"`` to
+    `evaluate_paged_serving_contract` (the SlotEngine's shared paged
+    decode step); ``kind="elastic"`` to `evaluate_elastic_contract`
+    (the resharded-vs-clean census pin).
     """
     import jax
 
@@ -1382,6 +1484,8 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
 
     if contract.kind == "serving":
         return evaluate_serving_contract(contract, mesh=mesh)
+    if contract.kind == "serving_paged":
+        return evaluate_paged_serving_contract(contract, mesh=mesh)
     if contract.kind == "elastic":
         return evaluate_elastic_contract(contract, mesh=mesh)
     if mesh is None:

@@ -119,6 +119,27 @@ def unpack_token_rows(outputs: np.ndarray, lengths: np.ndarray,
     return out
 
 
+def prompt_page_hashes(tokens: Sequence[int], page_size: int) -> List[str]:
+    """Content hashes of the FULLY prompt-covered KV pages of a prompt:
+    hash ``i`` digests ``tokens[0 : (i+1) * page_size]`` — the cumulative
+    prefix, not the lone page, because a KV page's contents depend on every
+    earlier token through attention's causal structure. Only pages wholly
+    inside the prompt get a hash (a partially-filled tail page also
+    receives DECODE writes, so it can never be shared). Two prompts with
+    equal hashes have bitwise-identical k/v for those pages under the same
+    weights — the prefix-sharing contract serving/paged.py's pool keys on.
+    """
+    import hashlib
+
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    toks = np.asarray(tokens, np.int64)
+    out: List[str] = []
+    for end in range(page_size, len(toks) + 1, page_size):
+        out.append(hashlib.sha1(toks[:end].tobytes()).hexdigest())
+    return out
+
+
 def _resize_center_crop(img, size: int) -> np.ndarray:
     """PIL image -> (size, size, 3) uint8: short-side resize + center crop."""
     w, h = img.size

@@ -478,7 +478,9 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
                          train_config=None, seed: int = 0,
                          optimizer: str = "auto", momentum: float = 0.9,
                          weight_decay: float = 5e-4,
-                         mesh_spec: Optional[str] = None):
+                         mesh_spec: Optional[str] = None,
+                         config=None, engine_cls=None,
+                         min_positions: int = 0):
     """(engine, mesh) for a serving config on a pure-DP mesh — the serving
     sibling of `build_trainer`, so bench rows and the CLI measure the same
     engine. Without ``ckpt_dir`` the weights are random-init (a smoke of
@@ -486,6 +488,13 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
     newest manifest-verified checkpoint restores through the same template
     machinery a training resume uses (``train_config`` carries the
     training run's zero1/fsdp/wire flags when they differ from defaults).
+
+    ``config``/``engine_cls`` swap in a richer config + engine pair
+    (`build_slot_engine` passes PagedServeConfig + SlotEngine) while every
+    other knob — checkpoint templates, mesh validation, vocab/positions
+    sizing — stays this one code path; ``min_positions`` widens the LM's
+    position table when the engine's padded view (pages) outgrows
+    ``max(buckets) + max_new_tokens``.
 
     The restore template's optimizer chain must STRUCTURALLY match the
     training run's (orbax validates the opt_state tree): the template is
@@ -509,8 +518,10 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
     spec = (MeshSpec.parse(mesh_spec) if mesh_spec
             else MeshSpec(data=len(devices)))
     mesh = build_mesh(spec, devices=list(devices))
-    cfg = ServeConfig(buckets=tuple(buckets), rows=rows,
-                      max_new_tokens=max_new_tokens, serve_dtype=serve_dtype)
+    cfg = config if config is not None else ServeConfig(
+        buckets=tuple(buckets), rows=rows,
+        max_new_tokens=max_new_tokens, serve_dtype=serve_dtype)
+    serve_dtype = cfg.serve_dtype
     dtype = jnp.bfloat16 if serve_dtype == "bf16" else jnp.float32
     if optimizer == "auto":
         optimizer = "adamw" if is_lm_model(model_name) else "sgd"
@@ -524,7 +535,7 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
         sample = np.zeros((1, 32, 32, 3), np.float32)
     else:
         kwargs = dict(model_overrides or {})
-        need = max(cfg.buckets) + cfg.max_new_tokens
+        need = max(max(cfg.buckets) + cfg.max_new_tokens, min_positions)
         kwargs.setdefault("max_position", max(512, need))
         model = get_model(model_name, dtype=dtype, **kwargs)
         sample = np.zeros((1, min(cfg.buckets)), np.int32)
@@ -534,22 +545,49 @@ def build_serving_engine(devices: Sequence[jax.Device], model_name: str,
 
     validate_mesh(mesh, rules=rules)
     serve_rules = rules if dict(mesh.shape).get("model", 1) > 1 else None
+    cls = engine_cls if engine_cls is not None else InferenceEngine
     if ckpt_dir:
-        engine = InferenceEngine.from_checkpoint(
+        engine = cls.from_checkpoint(
             ckpt_dir, model, mesh, cfg, tx, sample,
             train_config=train_config, rules=serve_rules)
     else:
         variables = model.init(jax.random.PRNGKey(seed), sample, train=False)
-        engine = InferenceEngine(model, mesh, cfg, variables["params"],
-                                 batch_stats=variables.get("batch_stats"),
-                                 rules=serve_rules)
+        engine = cls(model, mesh, cfg, variables["params"],
+                     batch_stats=variables.get("batch_stats"),
+                     rules=serve_rules)
     return engine, mesh
+
+
+def build_slot_engine(devices: Sequence[jax.Device], model_name: str,
+                      buckets: Sequence[int] = (8, 16), rows: int = 8,
+                      max_new_tokens: int = 8, kv_dtype: str = "fp32",
+                      page_size: int = 8, prefix_sharing: bool = True,
+                      n_pages: int = 0, **kw):
+    """(SlotEngine, mesh) — the token-granular sibling of
+    `build_serving_engine` (same checkpoint templates, mesh validation and
+    sizing; ``**kw`` forwards model_overrides/ckpt_dir/train_config/...).
+    The engine decodes over a paged, optionally int8 KV pool
+    (serving/continuous.py); ``min_positions`` is derived here because the
+    gathered dense view is ``pages_per_slot * page_size`` wide — page
+    padding can outgrow ``max(buckets) + max_new_tokens``."""
+    from ..serving.continuous import SlotEngine
+    from ..serving.paged import PagedServeConfig
+
+    cfg = PagedServeConfig(
+        buckets=tuple(buckets), rows=rows, max_new_tokens=max_new_tokens,
+        page_size=page_size, kv_dtype=kv_dtype, n_pages=n_pages,
+        prefix_sharing=prefix_sharing)
+    return build_serving_engine(
+        devices, model_name, buckets=buckets, rows=rows,
+        max_new_tokens=max_new_tokens, config=cfg, engine_cls=SlotEngine,
+        min_positions=cfg.pages_per_slot * cfg.page_size, **kw)
 
 
 def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
                     offered_rps: float = 16.0,
                     buckets: Sequence[int] = (16, 32), rows: int = 8,
                     max_new_tokens: int = 8, serve_dtype: str = "fp32",
+                    mixed_want: bool = False,
                     devices: Optional[Sequence[jax.Device]] = None,
                     model_overrides: Optional[dict] = None,
                     ckpt_dir: Optional[str] = None, seed: int = 0,
@@ -570,6 +608,15 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
     loaded. Offered load is what the schedule ASKS for; ``achieved_rps``
     is what the engine absorbed — an overloaded engine shows the gap
     honestly instead of averaging it away.
+
+    ``mixed_want=True`` is the serving-traffic workload of the
+    continuous-batching A/B: each request WANTS a per-request number of
+    tokens (1..max_new, same rng stream as the token-granular row). The
+    iteration engine has no per-request decode length — every batch
+    member decodes the full ``max_new_tokens`` — so ``tokens_per_sec``
+    counts only the WANTED tokens: the decode cycles spent past a
+    request's want are the convoy waste this mode exists to measure,
+    not throughput to credit.
     """
     import threading
 
@@ -606,6 +653,11 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
             for _ in range(n_requests)]
     prompts = [rng.randint(0, max(vocab, 2), n).astype(np.int32)
                for n in lens]
+    # drawn AFTER the prompts so both A/B rows (this and
+    # measure_serving_continuous) see identical prompt AND want streams
+    wants = ([int(rng.randint(1, max_new_tokens + 1))
+              for _ in range(n_requests)] if mixed_want
+             else [max_new_tokens] * n_requests)
     queue = RequestQueue(engine.config.buckets)
     stop = threading.Event()
     worker = threading.Thread(target=serve_forever,
@@ -636,6 +688,7 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
         "rows": rows,
         "max_new_tokens": max_new_tokens,
         "n_requests": n_requests,
+        "mixed_want": mixed_want,
         "offered_rps": offered_rps,
         "achieved_rps": round(n_requests / window_s, 2),
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
@@ -643,9 +696,9 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
         "mean_ms": round(float(lat_ms.mean()), 2),
         # only generating (causal-LM) engines produce tokens; a bert
         # embedding bench must not report a throughput for tokens that
-        # were never generated
-        **({"tokens_per_sec":
-            round(n_requests * max_new_tokens / window_s, 1)}
+        # were never generated. Under mixed_want only the WANTED tokens
+        # count — the engine decoded max_new for everyone regardless
+        **({"tokens_per_sec": round(sum(wants) / window_s, 1)}
            if engine.is_lm else {}),
         "compiles": engine.compiles,
         "recompiles_after_warmup": recompiles,
@@ -678,6 +731,178 @@ def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
     else:
         row["contracts"] = {"pass": None,
                             "skipped": "no decode step (not a causal LM)"}
+    return row
+
+
+def measure_serving_continuous(model_name: str = "gpt2_124m",
+                               n_requests: int = 24,
+                               offered_rps: float = 16.0,
+                               buckets: Sequence[int] = (8, 16),
+                               rows: int = 8, max_new_tokens: int = 8,
+                               kv_dtype: str = "fp32", page_size: int = 8,
+                               mixed_want: bool = False,
+                               replicas: int = 1,
+                               kill_replica: bool = False,
+                               temperature: float = 0.0, top_p: float = 1.0,
+                               devices: Optional[Sequence[jax.Device]] = None,
+                               model_overrides: Optional[dict] = None,
+                               ckpt_dir: Optional[str] = None, seed: int = 0,
+                               optimizer: str = "auto",
+                               momentum: float = 0.9,
+                               weight_decay: float = 5e-4,
+                               train_config=None,
+                               mesh_spec: Optional[str] = None) -> dict:
+    """Token-granular serving at fixed offered load — the continuous-
+    batching row next to `measure_serving`'s iteration-granular one (same
+    load schedule, same prompts, so the two rows are an apples-to-apples
+    A/B on tok/s and tail latency).
+
+    ``replicas`` in-process slot engines sit behind the stdlib `Router`
+    (least-depth dispatch, resubmit-on-death); ``kill_replica=True``
+    injects one replica death mid-load — the acceptance drill: every
+    request still completes, the survivors absorb the resubmissions, and
+    the compile census stays at warmup (``recompiles_after_warmup`` must
+    be 0 across joins, leaves, AND the death). The row also carries the
+    paged pool's HBM bytes against the dense fp32 baseline
+    (``kv_bytes_ratio`` — the int8-paged >= 3x claim is a recorded
+    number, not prose) and per-request TTFT percentiles (prefill emits
+    token #0, so TTFT is an admission-latency instrument the
+    iteration-granular engine cannot improve on).
+    """
+    from ..serving.router import InProcessReplica, Router
+
+    devices = list(devices) if devices is not None else jax.devices()
+    # Each replica gets its own DISJOINT device slice — the fleet
+    # topology (replicas never share chips), and a hard requirement
+    # in-process: the row-sharded decode step carries collectives, and
+    # two schedulers racing collective programs over OVERLAPPING devices
+    # deadlock in the CPU backend's rendezvous.
+    per = len(devices) // replicas
+    slices = ([devices[i * per:(i + 1) * per] for i in range(replicas)]
+              if replicas > 1 and per >= 1 else [devices] * replicas)
+    engines = []
+    for i in range(replicas):
+        engine, _ = build_slot_engine(
+            slices[i], model_name, buckets=buckets, rows=rows,
+            max_new_tokens=max_new_tokens, kv_dtype=kv_dtype,
+            page_size=page_size, model_overrides=model_overrides,
+            ckpt_dir=ckpt_dir, seed=seed, optimizer=optimizer,
+            momentum=momentum, weight_decay=weight_decay,
+            train_config=train_config, mesh_spec=mesh_spec)
+        engine.warmup()
+        engines.append(engine)
+    compiles_warm = [e.compiles for e in engines]
+
+    rng = np.random.RandomState(seed)
+    vocab = int(getattr(engines[0].model, "vocab_size", 0)) or 256
+    lens = [int(rng.randint(1, max(engines[0].config.buckets) + 1))
+            for _ in range(n_requests)]
+    prompts = [rng.randint(0, max(vocab, 2), n).astype(np.int32)
+               for n in lens]
+    # same rng order as measure_serving (lens, prompts, wants): identical
+    # want stream on both sides of the A/B. HERE the wants are honored —
+    # a slot retires at its want and the freed capacity admits the next
+    # request, which is the continuous-batching win being measured.
+    wants = ([int(rng.randint(1, max_new_tokens + 1))
+              for _ in range(n_requests)] if mixed_want
+             else [max_new_tokens] * n_requests)
+
+    router = Router([InProcessReplica(f"r{i}", e)
+                     for i, e in enumerate(engines)])
+    kill_at = n_requests // 3 if (kill_replica and replicas > 1) else None
+    gap = 1.0 / max(offered_rps, 1e-9)
+    reqs, sub_at = [], []
+    t_start = time.perf_counter()
+    for i, p in enumerate(prompts):
+        lag = t_start + i * gap - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        sub_at.append(time.perf_counter())
+        reqs.append(router.submit(p, max_new_tokens=wants[i],
+                                  temperature=temperature, top_p=top_p))
+        if kill_at is not None and i == kill_at:
+            # the injected death: everything in flight on r0 fails with
+            # ReplicaDead and the router resubmits it to the survivors
+            router.replicas["r0"].kill()
+    results = [r.result(timeout=600.0) for r in reqs]
+    # True completion stamps: RouterRequest.t_done is the WORKER's
+    # set_result time, not the moment this collection loop got around to
+    # calling result(). Stamping here instead would charge every request
+    # that finished during the pacing loop for the rest of the submission
+    # window — at 20 rps x 32 requests that's seconds of phantom p99.
+    done_at = [r.t_done for r in reqs]
+    # "alive" means survived the RUN — snapshot before stop() tears the
+    # scheduler threads down (after it, every replica reads unhealthy)
+    alive = {name: rep.healthy() for name, rep in router.replicas.items()}
+    router.stop()
+
+    # submit -> completion wall latency AT THE ROUTER (a resubmitted
+    # request's clock keeps running through its replica's death — the retry
+    # is paid, not hidden), same stamps measure_serving reads (Request.t_done)
+    lat_ms = np.array([(d - s) * 1e3 for s, d in zip(sub_at, done_at)])
+    ttft_ms = np.array([res.queue_wait_s * 1e3 for res in results])
+    window_s = max(max(done_at) - t_start, 1e-9)
+    n_tokens = int(sum(res.tokens.size for res in results))
+    per_replica = {}
+    for name, rep in router.replicas.items():
+        mine = [(reqs[i], lat_ms[i]) for i in range(n_requests)
+                if reqs[i].replica_name == name]
+        per_replica[name] = {
+            "served": rep.scheduler.served,
+            "alive": alive[name],
+            **({"p50_ms": round(float(np.percentile(
+                    [m for _, m in mine], 50)), 2),
+                "p99_ms": round(float(np.percentile(
+                    [m for _, m in mine], 99)), 2)} if mine else {}),
+        }
+    engine = engines[0]
+    row = {
+        "mode": "serving_continuous",
+        "granularity": "token",
+        "model": model_name,
+        "kv_dtype": kv_dtype,
+        "page_size": page_size,
+        "buckets": list(engine.config.buckets),
+        "rows": rows,
+        "max_new_tokens": max_new_tokens,
+        "n_requests": n_requests,
+        "mixed_want": mixed_want,
+        "completed": len(results),
+        "offered_rps": offered_rps,
+        "achieved_rps": round(n_requests / window_s, 2),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "mean_ms": round(float(lat_ms.mean()), 2),
+        "ttft_p50_ms": round(float(np.percentile(ttft_ms, 50)), 2),
+        "ttft_p99_ms": round(float(np.percentile(ttft_ms, 99)), 2),
+        "tokens_per_sec": round(n_tokens / window_s, 1),
+        "compiles": sum(e.compiles for e in engines),
+        "recompiles_after_warmup": sum(
+            e.compiles - w for e, w in zip(engines, compiles_warm)),
+        "replicas": replicas,
+        "replica_deaths": sum(r.replica_deaths for r in reqs),
+        "per_replica": per_replica,
+        # the HBM story: the paged (optionally int8) pool vs what the
+        # dense fp32 cache would hold for the same rows at the top rung
+        "paged_kv_bytes": engine.paged_bytes(),
+        "dense_kv_bytes": engine.dense_baseline_bytes(),
+        "checkpoint": engine.checkpoint_info,
+    }
+    row["kv_bytes_ratio"] = round(
+        row["dense_kv_bytes"] / max(row["paged_kv_bytes"], 1), 2)
+    try:
+        from ..analysis.hlo_rules import (
+            check_artifacts, paged_serving_artifacts,
+        )
+
+        artifacts = paged_serving_artifacts(engine, name="bench-paged")
+        findings = check_artifacts(artifacts)
+        row["contracts"] = {
+            "pass": not findings,
+            "violations": [f.as_dict() for f in findings]}
+    except Exception as e:  # noqa: BLE001 - observability never kills a row
+        row["contracts"] = {"pass": None,
+                            "error": f"{type(e).__name__}: {e}"}
     return row
 
 

@@ -206,6 +206,23 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
                        self.hidden_dim // self.num_heads), self.dtype)
         return tuple((z, z) for _ in range(self.depth))
 
+    def init_paged_pool(self, n_pages: int, page_size: int,
+                        quantized: bool = False):
+        """Zero-filled paged KV pool: ONE `layers.PagedKV` stacked over all
+        ``depth`` blocks — (depth, n_pages, page_size, heads, head_dim)
+        pages (int8 codes + per-row fp32 scales when ``quantized`` — the
+        wire-codec grid). The paged serving engine
+        (serving/continuous.py) gathers per-slot pages into the SAME dense
+        cache shape `init_cache` produces, so the decode forward above
+        runs unchanged — paging is a storage layout, not a numerics change
+        (PARITY.md)."""
+        from .layers import init_paged_kv
+
+        return init_paged_kv(self.depth, n_pages, page_size,
+                             self.num_heads,
+                             self.hidden_dim // self.num_heads,
+                             dtype=self.dtype, quantized=quantized)
+
     @staticmethod
     def partition_rules() -> PartitionRules:
         return tp_fsdp_rules()

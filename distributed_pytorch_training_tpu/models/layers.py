@@ -19,9 +19,10 @@ flash/ring kernels in `ops/` can replace the XLA einsum path per-config.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
+import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -118,6 +119,188 @@ def decode_dot_product_attention(
         weights, v.transpose(0, 2, 1, 3),
         (((3,), (2,)), ((0, 1), (0, 1))))  # (B, H, 1, D)
     return out.transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache substrate (fleet-scale serving, ISSUE 17)
+#
+# The dense per-request cache above allocates (rows, bucket + max_new, H, D)
+# per block whether a slot is live or not — the HBM ceiling at long
+# max_new_tokens. The paged form stores k/v in a POOL of fixed-size pages
+# (L, n_pages, page_size, H, D), stacked over every block so one gather /
+# one scatter serves the whole model; each serving slot owns a row of a
+# page TABLE
+# mapping its logical positions onto pool pages. The compiled decode step
+# gathers a slot's pages into the SAME dense (rows, T, H, D) view the
+# bitwise-pinned decode attention consumes, so fp32 paged decode inherits
+# the dense path's exactness proof verbatim: trailing/garbage positions are
+# masked to the fp32 min, their softmax weight underflows to exactly 0.0,
+# and adding 0.0 in the fp32 contraction is exact. int8 pages quantize each
+# (position, head) row over D through the gradient-wire codec grid
+# (``grad_sync._quantize_int8_rows`` — codes + one fp32 scale per row), a
+# bounded, deterministic, replica-identical perturbation (PARITY.md).
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class PagedKV:
+    """The model's paged KV pool, stacked across ALL blocks.
+
+    ``k``/``v`` are (L, n_pages, page_size, H, D) in the model dtype — one
+    leading layer axis over every transformer block — or int8 codes when
+    quantized, in which case ``k_scale``/``v_scale`` hold one fp32 scale
+    per (layer, page, position, head) row (the wire codec's per-row grid
+    over D). The stack is a performance contract, not a convenience: every
+    block's pages share one page table, so the decode step's read half is
+    ONE gather and its write half ONE scatter, instead of 2 x depth tiny
+    ops each paying their own dispatch (measured ~6 ms/step of pure
+    overhead on the 8-device CPU mesh at depth 4).
+
+    Page 0 is the SCRATCH page by convention (serving/paged.py): freed or
+    unallocated table entries point at it, so a gather is always in-bounds
+    and masked positions stay finite (0.0 x finite = 0.0 exactly; a NaN
+    would poison the masked softmax row)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def init_paged_kv(depth: int, n_pages: int, page_size: int, num_heads: int,
+                  head_dim: int, dtype: Dtype = jnp.float32,
+                  quantized: bool = False) -> PagedKV:
+    """Zero-filled paged pool for ALL ``depth`` blocks (stacked axis 0)."""
+    shape = (depth, n_pages, page_size, num_heads, head_dim)
+    if quantized:
+        return PagedKV(
+            k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:-1], jnp.float32),
+            v_scale=jnp.zeros(shape[:-1], jnp.float32))
+    # k and v must be DISTINCT buffers: the serving step donates the whole
+    # pool, and XLA rejects donating one buffer twice
+    return PagedKV(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _dequant_pages(codes: jnp.ndarray, scales: jnp.ndarray,
+                   dtype: Dtype) -> jnp.ndarray:
+    return (codes.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+def _quant_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int8-quantize (..., D) through the gradient-wire codec grid: one
+    scale per leading row over the trailing D axis — THE same absmax /
+    ``max(amax, 1e-30) * (1/127)`` / round/clip grid the wire uses, so the
+    KV-page error model is the wire codec's one-shot bound."""
+    from ..parallel.grad_sync import _quantize_int8_rows
+
+    lead = x.shape[:-1]
+    q, scales = _quantize_int8_rows(
+        x.astype(jnp.float32).reshape(-1, x.shape[-1]))
+    return q.reshape(x.shape), scales.reshape(lead)
+
+
+def gather_paged_kv(pkv: PagedKV, page_table: jnp.ndarray,
+                    dtype: Dtype = jnp.float32
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-slot dense view of the whole pool: ``page_table`` (rows, P)
+    int32 -> (L, rows, P * page_size, H, D) k and v in ``dtype``
+    (dequantized when the pool is int8) — ONE gather covering every layer.
+    Per-layer slices of the result feed the bitwise-pinned
+    `decode_dot_product_attention` unchanged; positions beyond a slot's
+    write frontier carry scratch/stale (finite) values the caller's mask
+    zeroes exactly."""
+    rows, pages = page_table.shape
+    depth, _, ps = pkv.k.shape[:3]
+
+    def dense(codes, scales):
+        g = codes[:, page_table]              # (L, rows, P, ps, H, D)
+        g = g.reshape(depth, rows, pages * ps, *g.shape[4:])
+        if scales is not None:
+            s = scales[:, page_table].reshape(depth, rows, pages * ps, -1)
+            return _dequant_pages(g, s, dtype)
+        return g.astype(dtype)
+
+    return dense(pkv.k, pkv.k_scale), dense(pkv.v, pkv.v_scale)
+
+
+def scatter_paged_rows(pkv: PagedKV, page_table: jnp.ndarray,
+                       positions: jnp.ndarray, k_rows: jnp.ndarray,
+                       v_rows: jnp.ndarray, active: jnp.ndarray) -> PagedKV:
+    """Write ONE fresh (H, D) k/v row per slot per layer — ``k_rows`` /
+    ``v_rows`` are (L, rows, H, D) — at that slot's own position: the paged
+    decode step's write half, ONE scatter covering every layer.
+    ``positions`` (rows,) int32, ``active`` (rows,) bool: inactive rows are
+    dropped by pointing their write at an out-of-range page
+    (``mode="drop"``), so finished/free slots never touch the pool (the
+    token-granular join/leave substrate)."""
+    n_pages, ps = pkv.k.shape[1], pkv.k.shape[2]
+    rows = positions.shape[0]
+    page = page_table[jnp.arange(rows), positions // ps]
+    page = jnp.where(active, page, n_pages)         # drop inactive writes
+    off = positions % ps
+
+    def put(store, scale_store, fresh):
+        if scale_store is not None:
+            q, s = _quant_rows(fresh)
+            return (store.at[:, page, off].set(q, mode="drop"),
+                    scale_store.at[:, page, off].set(s, mode="drop"))
+        return (store.at[:, page, off].set(fresh.astype(store.dtype),
+                                           mode="drop"), None)
+
+    k, ks = put(pkv.k, pkv.k_scale, k_rows)
+    v, vs = put(pkv.v, pkv.v_scale, v_rows)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def scatter_paged_prefill(pkv: PagedKV, page_row: jnp.ndarray,
+                          k_seqs: jnp.ndarray, v_seqs: jnp.ndarray,
+                          length: jnp.ndarray) -> PagedKV:
+    """Write one slot's prompt k/v — ``k_seqs`` / ``v_seqs`` (L, S, H, D),
+    every layer at once — into its pages, positions [0, length) only: the
+    paged prefill's write half. ``page_row`` (P,) is the slot's page-table
+    row; positions past ``length`` (bucket padding) are dropped, so a
+    shared prefix page is only ever rewritten with its own bytes
+    (identical params + identical tokens -> identical k/v, bitwise — the
+    prefix-sharing safety argument)."""
+    n_pages, ps = pkv.k.shape[1], pkv.k.shape[2]
+    s = k_seqs.shape[1]
+    idx = jnp.arange(s)
+    page = jnp.where(idx < length, page_row[idx // ps], n_pages)
+    off = idx % ps
+
+    def put(store, scale_store, fresh):
+        if scale_store is not None:
+            q, sc = _quant_rows(fresh)
+            return (store.at[:, page, off].set(q, mode="drop"),
+                    scale_store.at[:, page, off].set(sc, mode="drop"))
+        return (store.at[:, page, off].set(fresh.astype(store.dtype),
+                                           mode="drop"), None)
+
+    k, ks = put(pkv.k, pkv.k_scale, k_seqs)
+    v, vs = put(pkv.v, pkv.v_scale, v_seqs)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def paged_kv_bytes(pool) -> int:
+    """At-rest bytes of a paged pool (every block's codes + scales for
+    int8 pools, raw elements otherwise) — the serving analogue of
+    grad_sync's wire accounting, compared against `dense_kv_bytes`."""
+    import jax
+
+    return int(sum(arr.size * arr.dtype.itemsize
+                   for arr in jax.tree_util.tree_leaves(pool)))
+
+
+def dense_kv_bytes(rows: int, cache_len: int, num_heads: int, head_dim: int,
+                   depth: int, itemsize: int = 4) -> int:
+    """The dense engine's at-rest KV bytes at the same config — the
+    baseline the >= 3x int8-paged HBM cut is measured against."""
+    return 2 * depth * rows * cache_len * num_heads * head_dim * itemsize
 
 
 class MultiHeadAttention(nn.Module):

@@ -65,8 +65,8 @@ from .heartbeat import DEATHWATCH_EXIT_CODE
 # definition: the reader of the stamp owns the names) — re-exported here
 # because the orchestrator is the writer.
 __all__ = ["FLEET_GENERATION_ENV", "FLEET_RANK_ENV", "FleetOrchestrator",
-           "FleetLaunch", "FleetReport", "checkpoint_progress",
-           "check_fleet_flights", "fleet_main"]
+           "FleetLaunch", "FleetReport", "ReplicaProc", "ServingFleet",
+           "checkpoint_progress", "check_fleet_flights", "fleet_main"]
 
 _DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
 
@@ -417,6 +417,217 @@ class FleetOrchestrator:
                 f"fleet did not reach step {self.target_step} within "
                 f"{self.max_launches} launch(es)")
         return report
+
+
+@dataclasses.dataclass
+class ReplicaProc:
+    """One serving replica child under `ServingFleet`: the live process,
+    plus the death/relaunch history the report commits."""
+
+    rank: int
+    proc: Optional["subprocess.Popen"] = None
+    relaunches: int = 0
+    rc_history: List[int] = dataclasses.field(default_factory=list)
+    log_paths: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ServingFleet:
+    """N LONG-LIVED serving replicas under one supervisor — the serving
+    sibling of `FleetOrchestrator` (which runs training children one
+    generation at a time; a serving fleet runs its replicas
+    CONCURRENTLY, forever).
+
+    * ``argv_for(rank, generation)`` builds each replica's command (the
+      CLI passes ``serving serve --port base+rank --metrics-port ...``;
+      tests pass stubs — the supervisor is jax-free by the same design
+      rule as the training orchestrator and never inspects the argv);
+    * a replica that EXITS is relaunched (generation + 1, same rank)
+      until its ``max_relaunches`` budget is spent — a router in front
+      sees the gap as a failed /healthz and resubmits in the meantime;
+    * ``drain()`` is the SIGTERM contract fleet-wide: forward the signal
+      to every live child (each drains its own queue), wait, collect rcs;
+    * ``federation_port`` serves ONE merged /metrics page over the
+      replicas' ports (telemetry FederationServer) — the per-replica
+      ``serving_queue_depth`` / slot-occupancy gauges land on a single
+      dashboard, each row stamped with its replica's identity.
+
+    Generation + rank ride the child env exactly as training launches do
+    (``DPT_FLEET_GENERATION`` / ``DPT_FLEET_RANK``), so a dying replica's
+    flight is attributable to its slot in the fleet.
+    """
+
+    def __init__(self, argv_for: Callable[..., Sequence[str]],
+                 replicas: int,
+                 metrics_ports: Optional[Sequence[int]] = None,
+                 federation_port: Optional[int] = None,
+                 log_dir=None, env_extra: Optional[Dict[str, str]] = None,
+                 set_child_devices: bool = True, world: int = 8,
+                 max_relaunches: int = 2, poll_s: float = 0.2,
+                 log: Callable[[str], None] = _stderr_log):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if metrics_ports is not None and len(metrics_ports) != replicas:
+            raise ValueError(
+                f"metrics_ports must name one port per replica, got "
+                f"{len(metrics_ports)} for {replicas}")
+        self.argv_for = argv_for
+        self.n_replicas = int(replicas)
+        self.metrics_ports = (list(int(p) for p in metrics_ports)
+                              if metrics_ports else None)
+        self.federation_port = federation_port
+        self.log_dir = Path(log_dir) if log_dir is not None \
+            else Path(tempfile.mkdtemp(prefix="serving_fleet_"))
+        self.env_extra = dict(env_extra or {})
+        self.set_child_devices = set_child_devices
+        self.world = int(world)
+        self.max_relaunches = int(max_relaunches)
+        self.poll_s = float(poll_s)
+        self.log = log
+        self.replicas: List[ReplicaProc] = [
+            ReplicaProc(rank=r) for r in range(self.n_replicas)]
+        self.federation_page: Optional[str] = None
+        self._federation = None
+
+    def _child_env(self, rank: int, generation: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.env_extra)
+        env[FLEET_GENERATION_ENV] = str(generation)
+        env[FLEET_RANK_ENV] = str(rank)
+        if self.set_child_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = _xla_flags_for(self.world,
+                                              env.get("XLA_FLAGS", ""))
+        return env
+
+    def _spawn(self, rep: ReplicaProc) -> None:
+        generation = rep.relaunches
+        argv = list(self.argv_for(rank=rep.rank, generation=generation))
+        log_path = self.log_dir / f"replica{rep.rank}_gen{generation}.log"
+        rep.log_paths.append(str(log_path))
+        lf = open(log_path, "wb")
+        try:
+            rep.proc = subprocess.Popen(
+                argv, env=self._child_env(rep.rank, generation),
+                stdout=lf, stderr=subprocess.STDOUT)
+        finally:
+            # the child holds its own dup of the fd; Popen failure must
+            # not leak ours either
+            lf.close()
+        self.log(f"serving fleet: replica {rep.rank} up "
+                 f"(generation {generation}, pid {rep.proc.pid})")
+
+    def start(self) -> None:
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        if self.federation_port and self.metrics_ports:
+            from ..telemetry.metrics_http import FederationServer
+
+            self._federation = FederationServer(
+                int(self.federation_port),
+                targets=self.metrics_ports, refresh_s=self.poll_s)
+            try:
+                port = self._federation.start()
+                self.log(f"serving fleet: federated /metrics on :{port} "
+                         f"(fan-in over {self.metrics_ports})")
+            except OSError as e:
+                self.log(f"serving fleet: federation port "
+                         f"{self.federation_port} could not bind ({e}) — "
+                         "continuing without the fan-in")
+                self._federation = None
+        for rep in self.replicas:
+            self._spawn(rep)
+
+    def poll(self) -> int:
+        """One supervision pass: collect exits, relaunch within budget.
+        Returns how many replicas are currently alive."""
+        alive = 0
+        for rep in self.replicas:
+            if rep.alive:
+                alive += 1
+                continue
+            if rep.proc is not None and rep.proc.returncode is not None \
+                    and (not rep.rc_history
+                         or len(rep.rc_history) <= rep.relaunches):
+                rc = rep.proc.returncode
+                rep.rc_history.append(rc)
+                self.log(f"serving fleet: replica {rep.rank} exited "
+                         f"rc={rc} (generation {rep.relaunches})")
+                if rep.relaunches < self.max_relaunches:
+                    rep.relaunches += 1
+                    self._spawn(rep)
+                    alive += 1
+                else:
+                    self.log(f"serving fleet: replica {rep.rank} relaunch "
+                             f"budget spent ({self.max_relaunches}) — "
+                             "leaving it down")
+        return alive
+
+    def run(self, stop, duration_s: Optional[float] = None) -> int:
+        """Supervise until ``stop`` is set (or ``duration_s`` elapses),
+        then drain. Returns the number of replicas still alive at drain
+        time."""
+        deadline = (time.perf_counter() + duration_s
+                    if duration_s is not None else None)
+        try:
+            while not stop.is_set():
+                self.poll()
+                if deadline is not None and \
+                        time.perf_counter() >= deadline:
+                    break
+                stop.wait(self.poll_s)
+        finally:
+            alive = sum(1 for r in self.replicas if r.alive)
+            self.drain()
+        return alive
+
+    def kill_replica(self, rank: int) -> None:
+        """Chaos hook: hard-kill one replica (the injected death the
+        acceptance drill routes around)."""
+        rep = self.replicas[rank]
+        if rep.alive:
+            rep.proc.kill()
+            rep.proc.wait()
+
+    def drain(self, grace_s: float = 30.0) -> List[Optional[int]]:
+        """SIGTERM every live replica (each drains its own queue), wait
+        up to ``grace_s`` each, then collect return codes (kill-on-
+        timeout — a wedged replica must not hang the supervisor)."""
+        for rep in self.replicas:
+            if rep.alive:
+                rep.proc.terminate()
+        rcs: List[Optional[int]] = []
+        for rep in self.replicas:
+            if rep.proc is None:
+                rcs.append(None)
+                continue
+            try:
+                rcs.append(rep.proc.wait(timeout=grace_s))
+            except subprocess.TimeoutExpired:
+                self.log(f"serving fleet: replica {rep.rank} ignored "
+                         f"SIGTERM for {grace_s:.0f}s — killing")
+                rep.proc.kill()
+                rcs.append(rep.proc.wait())
+        if self._federation is not None:
+            self._federation.refresh()
+            self.federation_page = self._federation.render()
+            self._federation.stop()
+            self._federation = None
+        return rcs
+
+    def report(self) -> dict:
+        return {
+            "replicas": self.n_replicas,
+            "per_replica": [{
+                "rank": r.rank,
+                "relaunches": r.relaunches,
+                "rc_history": list(r.rc_history),
+                "alive": r.alive,
+            } for r in self.replicas],
+            "federation_page": bool(self.federation_page),
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,24 @@ Commands:
       census (zero recompiles after warmup is the contract), and the
       serving HLO-contract verdict — the serving row of the bench table
       (experiments/harness.py::measure_serving).
+      --continuous switches to the TOKEN-granular arm (slot engine +
+      paged/int8 KV, serving/continuous.py) — same load schedule, so the
+      two rows are the iteration-vs-token A/B; --replicas N spreads it
+      over N in-process replicas behind the stdlib router and
+      --kill-replica injects one replica death mid-load (every request
+      must still complete, recompiles must stay 0).
+  serve [--port P] [--kv-dtype int8] [--page-size N]
+      ONE long-lived continuous-batching replica: POST /generate
+      ({"tokens": [...], "max_new_tokens"?, "temperature"?, "top_p"?,
+      "seed"?, "want_logits"?}) blocks until the tokens are out; /healthz
+      + /metrics ride --metrics-port (the router reads both). SIGTERM
+      drains: admitted requests complete, then exit 0.
+  fleet [--replicas N] [--port BASE] [--federation-port P]
+      N `serve` replicas as supervised child processes (replica r on port
+      BASE+r, metrics on --metrics-port+r): a replica that dies is
+      relaunched within budget, SIGTERM drains the whole fleet, and
+      --federation-port serves the ONE merged /metrics dashboard
+      (resilience/fleet.py::ServingFleet).
 
 Health/drain: the resilience Deathwatch watches the relay ports exactly as
 train.py's does (opt-in via DPT_RELAY_PORTS); SIGTERM closes the queue,
@@ -58,7 +76,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="serving", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("command", choices=["smoke", "bench"])
+    p.add_argument("command", choices=["smoke", "bench", "serve", "fleet"])
     p.add_argument("--model", default="gpt2_124m")
     p.add_argument("--ckpt-dir", default=None,
                    help="serve the newest manifest-verified checkpoint "
@@ -99,10 +117,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="smoke: comma-separated token ids")
     p.add_argument("--prompt-len", type=int, default=12,
                    help="smoke: synthetic prompt length when no --prompt")
+    # continuous / paged serving (serve, fleet, bench --continuous)
+    p.add_argument("--continuous", action="store_true",
+                   help="bench: token-granular slot-engine arm (paged KV) "
+                        "instead of the iteration-granular engine")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="bench --continuous: in-process replicas behind "
+                        "the router; fleet: serve children to supervise")
+    p.add_argument("--kv-dtype", default="fp32", choices=["fp32", "int8"],
+                   help="paged KV pool dtype (int8: per-row quantized "
+                        "pages through the grad-sync int8 grid)")
+    p.add_argument("--page-size", type=int, default=8,
+                   help="positions per KV page (divide the top bucket + "
+                        "max-new for a padding-free pool)")
+    p.add_argument("--kill-replica", action="store_true",
+                   help="bench --continuous --replicas>1: kill replica 0 "
+                        "mid-load; the router must resubmit its requests")
+    p.add_argument("--port", type=int, default=8100,
+                   help="serve: /generate port (0 = ephemeral, logged); "
+                        "fleet: base port — replica r listens on base+r")
+    p.add_argument("--federation-port", type=int, default=None,
+                   help="fleet: one merged /metrics page over the "
+                        "replicas' ports (needs --metrics-port)")
     # bench
     p.add_argument("--requests", type=int, default=24)
     p.add_argument("--offered-load", type=float, default=16.0,
                    help="bench: offered request rate (req/s)")
+    p.add_argument("--mixed-want", action="store_true",
+                   help="bench: per-request decode lengths (1..max_new, "
+                        "seed-pinned) — the serving-traffic A/B workload; "
+                        "the iteration arm still decodes the full max_new "
+                        "per batch (it cannot honor per-request wants) "
+                        "and only the wanted tokens are credited")
     p.add_argument("--output-dir", default="./serving_out",
                    help="telemetry stream + flight directory")
     p.add_argument("--no-telemetry", action="store_true")
@@ -208,12 +254,52 @@ def _run(args, buckets) -> int:
                     if args.mesh else ""),
         config_tag=f"{args.model}-{args.serve_dtype}-rows{args.rows}"))
 
+    if args.command == "serve":
+        return _serve(args, buckets, overrides, train_config)
+    if args.command == "fleet":
+        return _fleet(args, buckets)
+
+    if args.command == "bench" and args.continuous:
+        from ..experiments.harness import measure_serving_continuous
+
+        row = measure_serving_continuous(
+            model_name=args.model, n_requests=args.requests,
+            offered_rps=args.offered_load, buckets=buckets, rows=args.rows,
+            max_new_tokens=args.max_new_tokens, kv_dtype=args.kv_dtype,
+            page_size=args.page_size, mixed_want=args.mixed_want,
+            replicas=args.replicas,
+            kill_replica=args.kill_replica, model_overrides=overrides,
+            ckpt_dir=args.ckpt_dir, seed=args.seed,
+            optimizer=args.optimizer, momentum=args.momentum,
+            weight_decay=args.weight_decay, train_config=train_config,
+            mesh_spec=args.mesh)
+        if args.as_json:
+            print(json.dumps(row, sort_keys=True, default=str))
+        else:
+            log_main(
+                f"serving bench [token-granular x{row['replicas']}]: "
+                f"{row['model']} kv={row['kv_dtype']} "
+                f"p50 {row['p50_ms']}ms p99 {row['p99_ms']}ms "
+                f"ttft p50 {row['ttft_p50_ms']}ms at "
+                f"{row['achieved_rps']}/{row['offered_rps']} req/s "
+                f"({row['tokens_per_sec']} tok/s), KV "
+                f"{row['paged_kv_bytes']}B vs dense "
+                f"{row['dense_kv_bytes']}B ({row['kv_bytes_ratio']}x), "
+                f"{row['compiles']} compiles "
+                f"({row['recompiles_after_warmup']} after warmup, "
+                f"{row['replica_deaths']} replica deaths)")
+            if row.get("contracts", {}).get("pass") is False:
+                log_main(f"serving bench: CONTRACT VIOLATIONS: "
+                         f"{row['contracts']['violations']}")
+        return 0 if row.get("recompiles_after_warmup") == 0 else 1
+
     if args.command == "bench":
         row = measure_serving(
             model_name=args.model, n_requests=args.requests,
             offered_rps=args.offered_load, buckets=buckets, rows=args.rows,
             max_new_tokens=args.max_new_tokens,
-            serve_dtype=args.serve_dtype, model_overrides=overrides,
+            serve_dtype=args.serve_dtype, mixed_want=args.mixed_want,
+            model_overrides=overrides,
             ckpt_dir=args.ckpt_dir, seed=args.seed,
             optimizer=args.optimizer, momentum=args.momentum,
             weight_decay=args.weight_decay, train_config=train_config,
@@ -303,6 +389,191 @@ def _run(args, buckets) -> int:
     finally:
         signal.signal(signal.SIGTERM, prev)
     log_main(f"serving smoke: ok ({engine.compiles} compiles)")
+    return 0
+
+
+def _serve(args, buckets, overrides, train_config) -> int:
+    """ONE long-lived continuous-batching replica behind stdlib HTTP:
+    POST /generate blocks the handler thread on the request's result
+    (ThreadingHTTPServer gives each request its own thread; the slot
+    scheduler worker is the single engine caller). SIGTERM drains."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import jax
+
+    from .. import telemetry
+    from ..experiments.harness import build_slot_engine
+    from ..utils.logging import log_main
+    from .batching import RequestQueue
+    from .continuous import ContinuousScheduler
+
+    engine, _ = build_slot_engine(
+        jax.devices(), args.model, buckets=buckets, rows=args.rows,
+        max_new_tokens=args.max_new_tokens, kv_dtype=args.kv_dtype,
+        page_size=args.page_size, model_overrides=overrides,
+        ckpt_dir=args.ckpt_dir, train_config=train_config, seed=args.seed,
+        optimizer=args.optimizer, momentum=args.momentum,
+        weight_decay=args.weight_decay, mesh_spec=args.mesh)
+    engine.warmup()
+    log_main(f"serving: slot engine ready — {engine.compiles} programs, "
+             f"kv={args.kv_dtype} pages of {args.page_size} "
+             f"({engine.paged_bytes()}B paged vs "
+             f"{engine.dense_baseline_bytes()}B dense)")
+    queue = RequestQueue(buckets)
+    sched = ContinuousScheduler(engine, queue)
+    stop = threading.Event()
+    worker = threading.Thread(target=sched.run, args=(stop,),
+                              kwargs={"log": log_main}, daemon=True)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):  # request logging rides telemetry
+            pass
+
+        def _reply(self, code: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                # the metrics port's /healthz is the richer step-fence
+                # verdict; this one answers 'is the replica accepting'
+                self._reply(200 if not stop.is_set() else 503,
+                            {"draining": stop.is_set(),
+                             "served": sched.served})
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n).decode() or "{}")
+                tokens = np.asarray(body["tokens"], np.int32)
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                req = queue.submit(
+                    tokens, max_new_tokens=body.get("max_new_tokens"),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    seed=body.get("seed"))
+                res = req.result(timeout=600.0)
+            except Exception as e:  # noqa: BLE001 - one request, one reply
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"})
+                return
+            out = {"tokens": res.tokens.tolist(), "bucket": res.bucket,
+                   "queue_wait_ms": round(res.queue_wait_s * 1e3, 3),
+                   "decode_ms": round(res.decode_s * 1e3, 3)}
+            if body.get("want_logits"):
+                out["last_logits"] = [float(v) for v in res.last_logits]
+            self._reply(200, out)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    port = httpd.server_address[1]
+
+    def on_sigterm(signum, frame):
+        log_main("serving: SIGTERM — draining the slot pool, then exiting")
+        stop.set()
+
+    prev = signal.signal(signal.SIGTERM, on_sigterm)
+    worker.start()
+    srv = threading.Thread(target=httpd.serve_forever, daemon=True)
+    srv.start()
+    log_main(f"serving: POST /generate on :{port} — SIGTERM drains")
+    try:
+        while not stop.wait(0.2):
+            pass
+    except KeyboardInterrupt:
+        stop.set()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        queue.close()
+        worker.join(timeout=600.0)
+        httpd.shutdown()
+    telemetry.flush_flight(cause="sigterm drain",
+                           detail="serving replica graceful shutdown",
+                           rc=0)
+    log_main(f"serving: replica drained ({sched.served} served, "
+             f"{engine.compiles} compiles)")
+    return 0
+
+
+def _fleet(args, buckets) -> int:
+    """N `serve` replicas as supervised children (ServingFleet): ports
+    base+r, metrics base+r (the child env's rank stamp applies the offset
+    — the argv passes the BASE, resolve_metrics_port adds the rank),
+    relaunch-on-death, SIGTERM drains the whole fleet."""
+    from ..resilience.fleet import ServingFleet
+    from ..telemetry.recorder import ALL_RANKS_ENV
+    from ..utils.logging import log_main
+
+    base = int(args.port)
+    mbase = args.metrics_port
+
+    def argv_for(rank: int, generation: int):
+        argv = [sys.executable, "-m",
+                "distributed_pytorch_training_tpu.serving", "serve",
+                "--model", args.model, "--buckets",
+                ",".join(str(b) for b in buckets),
+                "--rows", str(args.rows),
+                "--max-new-tokens", str(args.max_new_tokens),
+                "--kv-dtype", args.kv_dtype,
+                "--page-size", str(args.page_size),
+                "--port", str(base + rank),
+                "--output-dir",
+                str(Path(args.output_dir) / f"replica{rank}"),
+                "--seed", str(args.seed)]
+        if args.model_overrides:
+            argv += ["--model-overrides", args.model_overrides]
+        if args.ckpt_dir:
+            argv += ["--ckpt-dir", args.ckpt_dir]
+        if args.mesh:
+            argv += ["--mesh", args.mesh]
+        if mbase:
+            argv += ["--metrics-port", str(int(mbase))]
+        if args.no_telemetry:
+            argv += ["--no-telemetry"]
+        return argv
+
+    fleet = ServingFleet(
+        argv_for, replicas=args.replicas,
+        metrics_ports=([int(mbase) + r for r in range(args.replicas)]
+                       if mbase else None),
+        federation_port=args.federation_port,
+        log_dir=Path(args.output_dir) / "fleet_logs",
+        # every replica streams + serves /metrics, not just rank 0 —
+        # the federation page must carry all of them
+        env_extra={ALL_RANKS_ENV: "1"},
+        log=log_main)
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        log_main("serving fleet: SIGTERM — draining every replica")
+        stop.set()
+
+    prev = signal.signal(signal.SIGTERM, on_sigterm)
+    try:
+        fleet.start()
+        log_main(f"serving fleet: {args.replicas} replicas on ports "
+                 f"{[base + r for r in range(args.replicas)]}")
+        fleet.run(stop)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    report = fleet.report()
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True, default=str))
+    else:
+        for rep in report["per_replica"]:
+            log_main(f"serving fleet: replica {rep['rank']} — "
+                     f"{rep['relaunches']} relaunches, "
+                     f"rc history {rep['rc_history']}")
     return 0
 
 

@@ -61,18 +61,34 @@ class Request:
     _ids_lock = threading.Lock()
 
     def __init__(self, tokens: np.ndarray,
-                 return_prompt_logits: bool = False):
+                 return_prompt_logits: bool = False,
+                 max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: Optional[int] = None):
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim != 1 or tokens.size == 0:
             raise ValueError(
                 f"a request is a non-empty 1-D token array, got shape "
                 f"{tokens.shape}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         with Request._ids_lock:
             self.id = next(Request._ids)
         self.tokens = tokens
         self.return_prompt_logits = return_prompt_logits
+        # per-request sampling knobs (the continuous engine threads these
+        # per slot, like training's per-step RNG keys; temperature 0.0 is
+        # the pinned greedy path, bitwise). seed defaults to the request
+        # id so two unseeded requests never share a stream.
+        self.max_new_tokens = max_new_tokens
+        self.temperature = float(temperature)
+        self.top_p = float(top_p)
+        self.seed = int(self.id if seed is None else seed)
         self.t_submit = time.perf_counter()
         self.t_done: Optional[float] = None  # set at resolution (bench read)
+        self.t_first_token: Optional[float] = None  # TTFT (prefill emits #0)
         self._done = threading.Event()
         self._result: Optional[Result] = None
         self._error: Optional[BaseException] = None
@@ -112,12 +128,15 @@ class RequestQueue:
             return len(self._q)
 
     def submit(self, tokens: np.ndarray,
-               return_prompt_logits: bool = False) -> Request:
-        """Enqueue one prompt. Raises on a closed (draining) queue — the
-        SIGTERM contract: accepted work completes, new work is refused —
-        and on prompts no bucket fits (bucket_for's loud rejection beats
-        a truncated serve)."""
-        req = Request(tokens, return_prompt_logits=return_prompt_logits)
+               return_prompt_logits: bool = False, **kw) -> Request:
+        """Enqueue one prompt (``**kw``: the per-request sampling knobs —
+        max_new_tokens/temperature/top_p/seed — `Request` validates them).
+        Raises on a closed (draining) queue — the SIGTERM contract:
+        accepted work completes, new work is refused — and on prompts no
+        bucket fits (bucket_for's loud rejection beats a truncated
+        serve)."""
+        req = Request(tokens, return_prompt_logits=return_prompt_logits,
+                      **kw)
         bucket_for(len(req.tokens), self.buckets)  # validate: raises if huge
         with self._cv:
             if self._closed:
@@ -165,6 +184,29 @@ class RequestQueue:
         for req in group:
             telemetry.span_event("queue_wait", now - req.t_submit,
                                  request=req.id, bucket=bucket)
+        return group
+
+    def take(self, max_n: int,
+             timeout: Optional[float] = 0.05) -> List[Request]:
+        """Pop up to ``max_n`` requests in FIFO order, bucket-blind — the
+        token-granular admission path (serving/continuous.py): the slot
+        engine prefills each request on its OWN bucket's program, so there
+        is no shared-shape constraint and no reason to hold a short prompt
+        back behind a long one. Returns [] on timeout or when closed and
+        empty (the drain-finished signal); queue_wait here is only the
+        queue share — slot admission waits get their own ``slot_wait``
+        span."""
+        with self._cv:
+            if not self._q:
+                if self._closed:
+                    return []
+                self._cv.wait(timeout)
+            group = [self._q.popleft()
+                     for _ in range(min(max_n, len(self._q)))]
+        now = time.perf_counter()
+        for req in group:
+            telemetry.span_event("queue_wait", now - req.t_submit,
+                                 request=req.id)
         return group
 
 

@@ -1,0 +1,665 @@
+"""Token-granular continuous batching over a paged KV cache.
+
+PR 9's engine batches at ITERATION granularity: a group of requests
+enters prefill together, decodes together, and exits together — a short
+request waits for the longest batch-mate, and a new arrival waits for the
+whole cycle. This module rebuilds the decode loop around SLOTS:
+
+* `SlotEngine` owns ONE compiled decode step over a fixed pool of
+  ``rows`` slots plus one compiled prefill per bucket rung. A request is
+  admitted into a free slot by its bucket's prefill (slot index, prompt
+  length, sampling knobs are all TRACED scalars — admission never
+  recompiles), and from then on the shared decode step advances EVERY
+  live slot one token per call. Requests join and leave the running
+  batch at token granularity; the per-row position/budget masks are the
+  substrate (`budget > 0` is liveness, inactive rows' cache writes are
+  dropped).
+* The KV cache is the PAGED pool (models/layers.py): the decode step
+  gathers each slot's pages into the same dense view the bitwise-pinned
+  decode attention consumes, and scatters the one fresh row back. Page
+  residency is a host decision (serving/paged.py `PagePool`): prefix
+  sharing, eviction, int8 pages — none of it touches the compiled step.
+* Sampling is threaded PER REQUEST like training threads per-step RNG
+  keys: each slot carries its request's (key, temperature, top_p), and
+  the token at absolute position ``q`` is sampled with
+  ``fold_in(request_key, q)`` — a function of the request alone, so the
+  emitted stream is identical regardless of slot assignment, join order,
+  or batch company (the determinism satellite pins this).
+  ``temperature=0`` short-circuits to argmax — bitwise the PR 9 greedy
+  path.
+* `ContinuousScheduler` is the host loop: admit from the queue
+  (``RequestQueue.take`` — FIFO, bucket-blind), run the decode step,
+  mirror per-slot budgets in Python ints, and complete requests the
+  moment THEIR budget hits zero (host fetches happen here, outside the
+  AST-pinned ``_step_decode_loop``). ``slot_wait`` spans and the
+  slot-occupancy / page-pool gauges are emitted here.
+
+Layout: the page POOL is replicated over the mesh (pages are
+slot-agnostic — prefix sharing crosses slots), while the per-slot
+control arrays, page table, and every (rows, ...) intermediate of the
+decode step SHARD over the batch axis whenever rows divide the shard
+count — each device decodes its own slots and only the freshly written
+k/v rows all-gather back into the pool (tokens, (L, rows, H, D) — tiny).
+Everything is DONATED through both compiled programs, so each step
+updates in place — the ``serving_paged`` HLO contract (analysis/) pins
+the alias table the same way ``serving_decode`` pins the dense cache's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..data.pack import bucket_for
+from ..models.layers import (
+    dense_kv_bytes,
+    gather_paged_kv,
+    paged_kv_bytes,
+    scatter_paged_prefill,
+    scatter_paged_rows,
+)
+from ..parallel.mesh import batch_shard_count
+from ..parallel.sharding import batch_sharding, replicated
+from .batching import Request, RequestQueue, Result
+from .engine import InferenceEngine
+from .paged import PagedServeConfig, PageLease, PagePool
+
+
+def sample_tokens(logits: jnp.ndarray, keys: jnp.ndarray,
+                  temperatures: jnp.ndarray,
+                  top_ps: jnp.ndarray) -> jnp.ndarray:
+    """Per-row temperature/top-p sampling, (rows, vocab) logits -> (rows,)
+    int32 tokens. Every op is row-independent and each row consumes its
+    OWN key (``keys`` (rows, 2) uint32), so a row's token is a function of
+    (its logits, its key, its knobs) alone — batch-mates, slot index, and
+    pool size are invisible (the determinism contract). ``temperature <= 0``
+    selects plain argmax — bitwise the dense engine's greedy path; the
+    sampled branch's value is computed but discarded by the where."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / temps
+    order = jnp.argsort(-scaled, axis=-1)           # descending
+    sorted_l = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # nucleus: keep the smallest prefix with mass >= top_p; the first
+    # column always survives (cum - prob == 0 < top_p)
+    keep = (cum - probs) < top_ps[:, None]
+    masked = jnp.where(keep, sorted_l, jnp.finfo(jnp.float32).min)
+    choice = jax.vmap(lambda k, row: jax.random.categorical(k, row))(
+        keys, masked)
+    sampled = jnp.take_along_axis(
+        order, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+    return jnp.where(temperatures <= 0.0, greedy, sampled)
+
+
+class SlotEngine(InferenceEngine):
+    """The compiled half of continuous batching: one paged decode step
+    over the whole slot pool, one B=1 paged prefill per bucket, state
+    donated and chained device-to-device. ``compiles`` (inherited) is
+    still the census the zero-recompile contract reads: after `warmup`,
+    admissions, decode steps, and completions never compile."""
+
+    def __init__(self, model, mesh, config: PagedServeConfig, params,
+                 batch_stats: Any = None, rules=None):
+        if not isinstance(config, PagedServeConfig):
+            raise ValueError(
+                "SlotEngine needs a PagedServeConfig (page_size/kv_dtype "
+                "knobs) — plain ServeConfig drives the dense engine")
+        super().__init__(model, mesh, config, params,
+                         batch_stats=batch_stats, rules=rules)
+        if not self.is_lm:
+            raise ValueError("continuous batching decodes causal LMs only")
+        if self.padded_len > model.max_position:
+            raise ValueError(
+                f"pages_per_slot * page_size = {self.padded_len} exceeds "
+                f"the model's max_position {model.max_position} — the "
+                "gathered dense view must fit the position table")
+        self._rep = replicated(mesh)
+        # Slot rows shard over the mesh's batch shards whenever they
+        # divide — each device then decodes rows/n_shards slots instead of
+        # redundantly decoding ALL of them (replicated state means every
+        # device repeats the whole forward; on the 8-way CPU mesh that was
+        # an 8x per-step compute tax). The page POOL stays replicated —
+        # pages are slot-agnostic (prefix sharing crosses slots), so the
+        # decode step reads it locally and the written rows all-gather
+        # back (tiny: one (L, rows, H, D) per k/v per token).
+        n_shards = batch_shard_count(mesh)
+        self._row_sharded = n_shards > 1 and config.rows % n_shards == 0
+        self.reset_state()
+
+    def _validate_rows(self, n_shards: int) -> None:
+        """Slot rows shard over the batch shards when divisible and fall
+        back to replicated otherwise — the slot count is a scheduling
+        knob, never a hard layout constraint; any rows >= 1 works."""
+
+    def _row_sharding(self, ndim: int):
+        """Sharding for a (rows, ...) slot-state array: leading dim over
+        the batch shards when rows divide, replicated otherwise."""
+        if self._row_sharded:
+            return batch_sharding(self.mesh, ndim)
+        return self._rep
+
+    # -- state --------------------------------------------------------------
+
+    @property
+    def padded_len(self) -> int:
+        """Width of the gathered dense view (pages_per_slot * page_size,
+        >= bucket + max_new). The extra tail positions hold scratch/stale
+        FINITE values the decode mask zeroes exactly — same argument as
+        dense bucket padding."""
+        cfg: PagedServeConfig = self.config
+        return cfg.pages_per_slot * cfg.page_size
+
+    def _init_control(self) -> Dict[str, jnp.ndarray]:
+        cfg: PagedServeConfig = self.config
+        rows, vocab = cfg.rows, self.model.padded_vocab
+        return {
+            # token occupying `positions` (written by the NEXT decode step)
+            "tok": jnp.zeros((rows,), jnp.int32),
+            "positions": jnp.zeros((rows,), jnp.int32),
+            # tokens still to emit; budget > 0 IS slot liveness
+            "budget": jnp.zeros((rows,), jnp.int32),
+            "emitted": jnp.zeros((rows,), jnp.int32),
+            # per-request sampling state, threaded like per-step RNG keys
+            "keys": jnp.zeros((rows, 2), jnp.uint32),
+            "temps": jnp.zeros((rows,), jnp.float32),
+            "top_ps": jnp.ones((rows,), jnp.float32),
+            # per-slot output accumulators, fetched ONCE at completion
+            "out_buf": jnp.zeros((rows, cfg.max_new_tokens), jnp.int32),
+            "last_buf": jnp.zeros((rows, vocab), jnp.float32),
+        }
+
+    def reset_state(self) -> None:
+        """(Re)build the device state: zeroed paged pool (page 0 scratch —
+        all-finite by construction), idle control rows, all-scratch page
+        table. Compiled executables survive a reset (the census does not
+        restart)."""
+        cfg: PagedServeConfig = self.config
+        pool = self.model.init_paged_pool(
+            cfg.total_pages, cfg.page_size,
+            quantized=cfg.kv_dtype == "int8")
+        self._pool = jax.device_put(pool, self._rep)
+        self._control = {
+            k: jax.device_put(v, self._row_sharding(v.ndim))
+            for k, v in self._init_control().items()}
+        self._page_table = np.zeros(
+            (cfg.rows, cfg.pages_per_slot), np.int32)
+        self._table_dev = jax.device_put(self._page_table,
+                                         self._row_sharding(2))
+
+    def set_page_row(self, slot: int, row: np.ndarray) -> None:
+        """Point one slot's table row at its leased pages (all-zeros =
+        scratch = released). Host numpy is the source of truth; the device
+        copy refreshes here — NEVER inside the decode loop."""
+        self._page_table[slot] = row
+        self._table_dev = jax.device_put(self._page_table,
+                                         self._row_sharding(2))
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _rep_aval(self, shape, dtype) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=self._rep)
+
+    def _row_aval(self, shape, dtype) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    sharding=self._row_sharding(len(shape)))
+
+    def _pool_avals(self):
+        return jax.tree_util.tree_map(
+            lambda x: self._rep_aval(x.shape, x.dtype), self._pool)
+
+    def _control_avals(self):
+        return {k: self._row_aval(v.shape, v.dtype)
+                for k, v in self._control.items()}
+
+    def _make_paged_prefill(self, bucket: int) -> Callable:
+        cfg: PagedServeConfig = self.config
+
+        def prefill(served, pool, control, page_table, ids, length, slot,
+                    want, key, temp, top_p):
+            params = self._dequant(served)
+            cache0 = self.model.init_cache(1, bucket)
+            logits, cache = self.model.apply(
+                self._apply_vars(params), ids, train=False, cache=cache0)
+            # eval-forward-bitwise logits; token #0 comes from the last
+            # REAL prompt position and occupies absolute position `length`
+            last = jnp.take(logits[0], jnp.maximum(length - 1, 0), axis=0)
+            k0 = jax.random.fold_in(key, length)
+            t0 = sample_tokens(last[None, :], k0[None, :], temp[None],
+                               top_p[None])[0]
+            page_row = page_table[slot]
+            # stack the per-block prompt k/v to (L, S, H, D): the pool is
+            # layer-stacked, so the whole prompt lands in ONE scatter
+            k_seqs = jnp.stack([c[0][0] for c in cache])
+            v_seqs = jnp.stack([c[1][0] for c in cache])
+            new_pool = scatter_paged_prefill(pool, page_row, k_seqs,
+                                             v_seqs, length)
+            out_row = jnp.zeros((cfg.max_new_tokens,), jnp.int32)
+            out_row = out_row.at[0].set(t0)
+            control = dict(control)
+            control["tok"] = control["tok"].at[slot].set(t0)
+            control["positions"] = control["positions"].at[slot].set(length)
+            control["budget"] = control["budget"].at[slot].set(want - 1)
+            control["emitted"] = control["emitted"].at[slot].set(1)
+            control["keys"] = control["keys"].at[slot].set(key)
+            control["temps"] = control["temps"].at[slot].set(temp)
+            control["top_ps"] = control["top_ps"].at[slot].set(top_p)
+            control["out_buf"] = control["out_buf"].at[slot].set(out_row)
+            control["last_buf"] = control["last_buf"].at[slot].set(last)
+            return new_pool, control
+
+        return prefill
+
+    def _make_paged_decode(self) -> Callable:
+        rows = self.config.rows
+
+        def decode(served, pool, control, page_table):
+            params = self._dequant(served)
+            active = control["budget"] > 0
+            positions = control["positions"]
+            tok = control["tok"]
+            # read half: every slot's pages -> the dense view the
+            # bitwise-pinned decode attention consumes unchanged. The pool
+            # is layer-stacked, so this is ONE gather; the per-layer
+            # slices below are fused into their attention consumers.
+            k_all, v_all = gather_paged_kv(pool, page_table,
+                                           dtype=self.model.dtype)
+            cache = tuple((k_all[l], v_all[l])
+                          for l in range(self.model.depth))
+            logits, new_cache = self.model.apply(
+                self._apply_vars(params), tok[:, None], train=False,
+                cache=cache, cache_positions=positions)
+            # write half: ONE fresh (H, D) row per live slot per layer,
+            # restacked to (L, rows, H, D) -> ONE scatter back to the pool
+            idx = positions[:, None, None, None]
+            k_rows = jnp.stack([
+                jnp.take_along_axis(k_new, idx, axis=1)[:, 0]
+                for k_new, _ in new_cache])
+            v_rows = jnp.stack([
+                jnp.take_along_axis(v_new, idx, axis=1)[:, 0]
+                for _, v_new in new_cache])
+            new_pool = scatter_paged_rows(pool, page_table, positions,
+                                          k_rows, v_rows, active)
+            # the token at position p+1, from THIS request's key stream
+            step_keys = jax.vmap(jax.random.fold_in)(
+                control["keys"], positions + 1)
+            nxt = sample_tokens(logits[:, 0], step_keys, control["temps"],
+                                control["top_ps"])
+            act = active.astype(jnp.int32)
+            safe_row = jnp.where(active, jnp.arange(rows), rows)
+            out_buf = control["out_buf"].at[
+                safe_row, control["emitted"]].set(nxt, mode="drop")
+            new_control = dict(control)
+            new_control["tok"] = jnp.where(active, nxt, tok)
+            new_control["positions"] = positions + act
+            new_control["budget"] = control["budget"] - act
+            new_control["emitted"] = control["emitted"] + act
+            new_control["out_buf"] = out_buf
+            return new_pool, new_control
+
+        return decode
+
+    def _rep_out(self, tree):
+        return jax.tree_util.tree_map(lambda _: self._rep, tree)
+
+    def _out_shardings(self, tree):
+        """Each output keeps its aval's own sharding (pool replicated,
+        control row-sharded) — donation requires in/out layouts to
+        match."""
+        return jax.tree_util.tree_map(lambda x: x.sharding, tree)
+
+    def lower_paged_prefill(self, bucket: int):
+        """The lowered B=1 admission step — slot/length/knobs traced, pool
+        + control DONATED (exposed for the serving_paged contract)."""
+        cfg: PagedServeConfig = self.config
+        pool_avals = self._pool_avals()
+        ctrl_avals = self._control_avals()
+        scalar_i = self._rep_aval((), jnp.int32)
+        scalar_f = self._rep_aval((), jnp.float32)
+        outs = (pool_avals, ctrl_avals)
+        return jax.jit(
+            self._make_paged_prefill(bucket), donate_argnums=(1, 2),
+            out_shardings=self._out_shardings(outs),
+        ).lower(self._served, pool_avals, ctrl_avals,
+                self._row_aval((cfg.rows, cfg.pages_per_slot), jnp.int32),
+                self._rep_aval((1, bucket), jnp.int32),
+                scalar_i, scalar_i, scalar_i,
+                self._rep_aval((2,), jnp.uint32), scalar_f, scalar_f)
+
+    def lower_paged_decode(self):
+        """The lowered shared decode step: advances every live slot one
+        token. Pool + control are DONATED — in-place page updates are what
+        the page-table-donation HLO rule pins."""
+        cfg: PagedServeConfig = self.config
+        pool_avals = self._pool_avals()
+        ctrl_avals = self._control_avals()
+        outs = (pool_avals, ctrl_avals)
+        return jax.jit(
+            self._make_paged_decode(), donate_argnums=(1, 2),
+            out_shardings=self._out_shardings(outs),
+        ).lower(self._served, pool_avals, ctrl_avals,
+                self._row_aval((cfg.rows, cfg.pages_per_slot), jnp.int32))
+
+    def _executable(self, kind: str, bucket: int):
+        if kind not in ("paged_prefill", "paged_decode"):
+            return super()._executable(kind, bucket)
+        key = (kind, bucket)
+        if key not in self._compiled:
+            lowered = (self.lower_paged_prefill(bucket)
+                       if kind == "paged_prefill"
+                       else self.lower_paged_decode())
+            with telemetry.span("compile", program=kind, bucket=bucket):
+                self._compiled[key] = lowered.compile()
+            self.compiles += 1
+        return self._compiled[key]
+
+    def warmup(self) -> int:
+        """Compile the decode step + every bucket's prefill up front; the
+        census is flat from here (the zero-recompile acceptance)."""
+        self._executable("paged_decode", 0)
+        for b in self.config.buckets:
+            self._executable("paged_prefill", b)
+        return self.compiles
+
+    # -- the three runtime entries (scheduler-facing) ------------------------
+
+    def admit(self, slot: int, tokens: np.ndarray, want: int,
+              temperature: float, top_p: float, seed: int) -> int:
+        """Dispatch the slot's admission prefill (token #0 is emitted
+        inside) and return the bucket served. Does NOT fence: the prefill
+        rides the donated pool/control chain and the scheduler's per-step
+        fence bounds it — fencing every admission would serialize the
+        whole admission wave behind host-device round trips (measured
+        ~25% of capacity at saturation)."""
+        cfg: PagedServeConfig = self.config
+        bucket = bucket_for(len(tokens), cfg.buckets)
+        ids = np.full((1, bucket), cfg.pad_id, np.int32)
+        ids[0, :len(tokens)] = tokens
+        key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        dev = lambda x: jax.device_put(x, self._rep)  # noqa: E731
+        pre = self._executable("paged_prefill", bucket)
+        self._pool, self._control = pre(
+            self._served, self._pool, self._control, self._table_dev,
+            dev(ids), dev(np.int32(len(tokens))), dev(np.int32(slot)),
+            dev(np.int32(want)), dev(key),
+            dev(np.float32(temperature)), dev(np.float32(top_p)))
+        return bucket
+
+    def decode_step(self) -> None:
+        """One compiled decode step over the whole slot pool — every
+        chained value stays on device (no fetch; the scheduler's
+        ``_step_decode_loop`` is the AST-pinned caller)."""
+        dec = self._executable("paged_decode", 0)
+        self._pool, self._control = dec(
+            self._served, self._pool, self._control, self._table_dev)
+
+    def fetch_slot(self, slot: int) -> Tuple[np.ndarray, np.ndarray]:
+        """ONE host fetch of a finished slot's outputs (tokens row +
+        last-prompt logits) — completion-time only, never in the loop."""
+        return jax.device_get((self._control["out_buf"][slot],
+                               self._control["last_buf"][slot]))
+
+    # -- byte accounting -----------------------------------------------------
+
+    def paged_bytes(self) -> int:
+        """At-rest bytes of the live paged pool (codes + scales when
+        int8); compare `kv_cache_bytes` (inherited) for the dense fp32
+        baseline the >= 3x cut is measured against."""
+        return paged_kv_bytes(self._pool)
+
+    def dense_baseline_bytes(self) -> int:
+        """What the PR 9 dense engine would hold at this config, fp32."""
+        cfg: PagedServeConfig = self.config
+        return dense_kv_bytes(
+            cfg.rows, max(cfg.buckets) + cfg.max_new_tokens,
+            self.model.num_heads,
+            self.model.hidden_dim // self.model.num_heads,
+            self.model.depth)
+
+
+@dataclasses.dataclass
+class _SlotState:
+    """Host mirror of one live slot: enough to detect completion without
+    touching the device (the device's budget arithmetic is replayed in
+    Python ints, one decrement per decode step)."""
+
+    req: Request
+    lease: PageLease
+    bucket: int
+    want: int
+    left: int  # tokens still to emit (device budget mirror)
+
+
+class ContinuousScheduler:
+    """The host loop: queue -> slots -> compiled steps -> results.
+
+    Single-threaded over the engine (the device programs chain donated
+    state, so there is exactly one legal caller at a time); thread-safety
+    toward producers lives in `RequestQueue`. `run` is the worker-loop
+    analogue of batching.serve_forever — stop means DRAIN (admitted and
+    queued work completes; new work is refused), `kill` is the chaos hook
+    (fail everything in flight, the router resubmits elsewhere)."""
+
+    def __init__(self, engine: SlotEngine, queue: RequestQueue):
+        cfg: PagedServeConfig = engine.config
+        self.engine = engine
+        self.queue = queue
+        self.pool = PagePool(cfg.total_pages, cfg.page_size,
+                             cfg.pages_per_slot,
+                             prefix_sharing=cfg.prefix_sharing)
+        self.free_slots: List[int] = list(range(cfg.rows))
+        self.running: Dict[int, _SlotState] = {}
+        self.pending: List[Request] = []
+        self._t_popped: Dict[int, float] = {}
+        self.served = 0
+        self.killed = False
+        # max decode steps per fence when nothing is waiting to join
+        # (see step()); 1 restores strict fence-per-token behavior
+        self.burst_steps = 4
+
+    # -- admission -----------------------------------------------------------
+
+    def _gauges(self) -> None:
+        cfg: PagedServeConfig = self.engine.config
+        telemetry.gauge("serving_slot_occupancy",
+                        len(self.running) / max(cfg.rows, 1))
+        telemetry.gauge("serving_page_pool_free", self.pool.free_pages())
+        # the router's load signal: everything accepted but unfinished
+        # (HttpReplica.queue_depth scrapes this off /metrics)
+        telemetry.gauge("serving_queue_depth",
+                        len(self.queue) + len(self.pending)
+                        + len(self.running))
+
+    def _try_admit(self, req: Request) -> bool:
+        """One admission attempt: needs a free slot AND a page lease.
+        False means 'not now' (the request stays pending) — admission
+        pressure is absorbed here, never by a recompile."""
+        if not self.free_slots:
+            return False
+        cfg: PagedServeConfig = self.engine.config
+        want = cfg.max_new_tokens if req.max_new_tokens is None else \
+            min(int(req.max_new_tokens), cfg.max_new_tokens)
+        want = max(want, 1)
+        lease = self.pool.alloc(req.tokens, len(req.tokens) + want)
+        if lease is None:
+            return False
+        slot = self.free_slots.pop()
+        self.engine.set_page_row(slot, lease.pages)
+        t0 = time.perf_counter()
+        bucket = self.engine.admit(slot, req.tokens, want, req.temperature,
+                                   req.top_p, req.seed)
+        now = time.perf_counter()
+        # t_first_token stays None until the NEXT step fence — admit()
+        # only dispatched the prefill; step() stamps it once the fence
+        # proves token #0 landed. The span here is the dispatch cost.
+        telemetry.span_event("prefill", now - t0, bucket=bucket, slot=slot,
+                             request=req.id)
+        telemetry.span_event(
+            "slot_wait", now - self._t_popped.pop(req.id, now),
+            request=req.id, slot=slot)
+        self.running[slot] = _SlotState(req=req, lease=lease, bucket=bucket,
+                                        want=want, left=want - 1)
+        self._gauges()
+        return True
+
+    def _admit_pending(self) -> None:
+        still: List[Request] = []
+        for req in self.pending:
+            if not self._try_admit(req):
+                still.append(req)
+        self.pending = still
+
+    def _pull(self, timeout: float = 0.005) -> None:
+        # keep at most ~2 pool-fulls on deck; never block while slots are
+        # actively decoding (the queue wait is for the idle loop only)
+        cap = 2 * self.engine.config.rows - len(self.pending)
+        if cap <= 0:
+            return
+        got = self.queue.take(cap,
+                              timeout=0.0 if self.running else timeout)
+        now = time.perf_counter()
+        for req in got:
+            self._t_popped[req.id] = now
+        self.pending.extend(got)
+
+    # -- the decode hot loop -------------------------------------------------
+
+    def _step_decode_loop(self, n_steps: int) -> None:
+        """``n_steps`` compiled decode steps, mirrors replayed in Python —
+        NO host fetch in here (the ``no-host-sync-in-decode`` lint pins
+        this function by name). Completion fetches happen afterwards, in
+        `_complete`."""
+        for _ in range(n_steps):
+            self.engine.decode_step()
+            for st in self.running.values():
+                if st.left > 0:
+                    st.left -= 1
+
+    def _complete_finished(self) -> None:
+        t0 = time.perf_counter()
+        done = [slot for slot, st in self.running.items() if st.left == 0]
+        for slot in done:
+            st = self.running.pop(slot)
+            toks, last = self.engine.fetch_slot(slot)
+            now = time.perf_counter()
+            first = st.req.t_first_token or t0
+            res = Result(tokens=np.asarray(toks[:st.want], np.int32),
+                         last_logits=np.asarray(last),
+                         bucket=st.bucket,
+                         queue_wait_s=max(0.0, first - st.req.t_submit),
+                         decode_s=max(0.0, now - first))
+            self.pool.release(st.lease)
+            self.engine.set_page_row(
+                slot, np.zeros(self.engine.config.pages_per_slot, np.int32))
+            self.free_slots.append(slot)
+            st.req.set_result(res)
+            self.served += 1
+        if done:
+            self._gauges()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduling iteration: pull, admit, decode one token for
+        every live slot, complete. Returns whether any work remains in
+        flight or pending.
+
+        The fence bounds dispatch depth: Python dispatches faster than
+        the device decodes, and without it the queued-step backlog grows
+        without bound — every completion fetch then waits behind the
+        WHOLE backlog (the donated pool chain serializes), and
+        per-request latency balloons with uptime. It must fence on the
+        step's own OUTPUT: any earlier buffer was already donated into
+        this dispatch and cannot be blocked on. It is a device fence, not
+        a host transfer — the per-token no-host-sync contract
+        (`_step_decode_loop`) is untouched.
+
+        When NOTHING is waiting to join (queue and pending both empty),
+        the loop bursts up to `burst_steps` decode steps before fencing —
+        no slot can finish earlier than its remaining budget, so the
+        burst never delays a completion, and a request arriving mid-burst
+        waits at most `burst_steps` tokens for admission (the
+        token-granularity bound, traded explicitly for fewer host-device
+        round trips on long decodes)."""
+        self._pull()
+        self._admit_pending()
+        if self.running:
+            steps = 1
+            if not self.pending and not len(self.queue):
+                steps = max(1, min(min(st.left for st in
+                                       self.running.values()),
+                                   self.burst_steps))
+            self._step_decode_loop(steps)
+            jax.block_until_ready(self.engine._control["tok"])
+            # the fence proves every dispatched prefill's token #0 landed:
+            # the honest (if slightly late) time-to-first-token stamp
+            now = time.perf_counter()
+            for st in self.running.values():
+                if st.req.t_first_token is None:
+                    st.req.t_first_token = now
+            self._complete_finished()
+        return bool(self.running or self.pending)
+
+    def run(self, stop: threading.Event, log=None) -> int:
+        """Serve until ``stop`` is set AND everything accepted has
+        completed (stop = drain, the SIGTERM contract). Returns requests
+        served."""
+        while not self.killed:
+            if stop.is_set():
+                self.queue.close()
+            busy = self.step()
+            if stop.is_set() and not busy and not len(self.queue):
+                break
+        if self.killed and log is not None:
+            log("serving: scheduler killed with "
+                f"{len(self.running) + len(self.pending)} in flight")
+        return self.served
+
+    def drain(self, log=None) -> int:
+        """Finish everything queued + in flight, then return — wrapped in
+        the ``drain`` span like the iteration-granular path."""
+        stop = threading.Event()
+        stop.set()
+        with telemetry.span("drain",
+                            pending=len(self.queue) + len(self.pending),
+                            running=len(self.running)):
+            return self.run(stop, log=log)
+
+    def kill(self, err: Optional[BaseException] = None) -> List[Request]:
+        """Chaos hook: fail every in-flight, pending, AND still-queued
+        request (the injected replica death). Returns the failed requests
+        — the router resubmits them to surviving replicas."""
+        self.killed = True
+        err = err or RuntimeError("replica died")
+        failed: List[Request] = []
+        for st in self.running.values():
+            st.req.set_error(err)
+            failed.append(st.req)
+        for req in self.pending:
+            req.set_error(err)
+            failed.append(req)
+        # accepted-but-unpulled requests die with the replica too: left
+        # parked in the closed queue they would hang their waiters forever
+        # (no worker remains to pull them)
+        self.queue.close()
+        for req in self.queue.take(len(self.queue) + 1, timeout=0.0):
+            req.set_error(err)
+            failed.append(req)
+        self.running.clear()
+        self.pending.clear()
+        return failed
+
+
+def serve_continuous(engine: SlotEngine, queue: RequestQueue,
+                     stop: threading.Event, log=None) -> int:
+    """Drop-in worker-loop twin of ``batching.serve_forever`` for the
+    continuous engine (the CLI runs one per replica thread)."""
+    return ContinuousScheduler(engine, queue).run(stop, log=log)

@@ -182,11 +182,7 @@ class InferenceEngine:
                 "yet: the per-row quantized codes carry their own layout "
                 "(serve fp32/bf16 with --mesh model>1, or int8 on a 1-D "
                 "mesh)")
-        if config.rows % n_shards:
-            raise ValueError(
-                f"rows={config.rows} must divide over the mesh's "
-                f"{n_shards} batch shards — every compiled program's row "
-                "dimension is sharded over them")
+        self._validate_rows(n_shards)
         # three serve modes: causal LM (prefill + KV-cache decode), token
         # batch (bert — one bucketed forward, logits/embeddings out), image
         # batch (resnet/vit — fixed-shape forward via serve_images)
@@ -223,6 +219,16 @@ class InferenceEngine:
         self.compiles = 0
         # provenance of the served weights (from_checkpoint fills this)
         self.checkpoint_info: Optional[dict] = None
+
+    def _validate_rows(self, n_shards: int) -> None:
+        """Dense engine: the row dimension shards over the mesh's batch
+        shards, so rows must divide. The slot engine overrides (its state
+        is replicated — slot count is a scheduling knob, not a layout)."""
+        if self.config.rows % n_shards:
+            raise ValueError(
+                f"rows={self.config.rows} must divide over the mesh's "
+                f"{n_shards} batch shards — every compiled program's row "
+                "dimension is sharded over them")
 
     # -- checkpoint loading -------------------------------------------------
 
@@ -435,6 +441,22 @@ class InferenceEngine:
                 if self.is_lm:
                     self._executable("decode", b)
         return self.compiles
+
+    def kv_cache_bytes(self, bucket: Optional[int] = None) -> int:
+        """At-rest bytes of this engine's dense KV cache at ``bucket``
+        (default: the top rung — the engine's HBM ceiling). The baseline
+        the paged engine's >= 3x int8 cut is measured against
+        (models/layers.dense_kv_bytes; bench serving records both)."""
+        from ..models.layers import dense_kv_bytes
+
+        if not self.is_lm:
+            return 0
+        b = max(self.config.buckets) if bucket is None else int(bucket)
+        return dense_kv_bytes(
+            self.config.rows, b + self.config.max_new_tokens,
+            self.model.num_heads, self.model.hidden_dim // self.model.num_heads,
+            self.model.depth,
+            itemsize=jnp.dtype(self.model.dtype).itemsize)
 
     # -- serving ------------------------------------------------------------
 

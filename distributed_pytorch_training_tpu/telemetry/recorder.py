@@ -79,7 +79,13 @@ SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync", "eval",
 # and decode dispatch walls, and the shutdown drain. `telemetry summary`
 # buckets these exactly like the training phases — a serving stream's
 # latency story decomposes instead of lumping into "unaccounted".
-SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain")
+# The continuous-batching path (ISSUE 17) adds two host-side phases:
+# `slot_wait` (popped from the queue -> admitted into a slot — the
+# pool/page-pressure share of latency, distinct from queue_wait's
+# load share) and `router_dispatch` (the multi-replica router's pick +
+# submit wall, including health probes).
+SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain",
+                      "slot_wait", "router_dispatch")
 
 # The elastic phases (ISSUEs 11 + 12): mesh re-planning after a replica
 # death, the checkpoint reshard (N -> M re-slice), the grow-side live

@@ -62,6 +62,13 @@ _FILE_BUDGETS_S = {
     # a fresh shard_map step over the (slice=2, data=4) mesh, plus one
     # contract evaluation — per-leg compile cost is the budget driver.
     "test_hier.py": 150.0,             # measured ~39 s fast
+    # The continuous-batching suite (ISSUE 17): four SlotEngine warmups
+    # (fp32 + int8 on the 8-way mesh, two fleet replicas on 4-device
+    # slices), one contract evaluation, and one jitted fixed-pad
+    # reference forward for the bitwise pins — compile count is the
+    # budget driver, so a new engine config or bucket rung must name
+    # itself here.
+    "test_continuous.py": 150.0,       # measured ~33 s fast
 }
 _file_seconds: dict = {}
 

@@ -27,13 +27,15 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "zero1_int8_hier",
                              "fsdp", "fsdp_accum", "fsdp_int8_mh",
                              "fsdp_tp", "fsdp_tp_int8_mh",
-                             "serving_decode", "elastic_reshard",
+                             "serving_decode", "serving_paged",
+                             "elastic_reshard",
                              "elastic_grow"}
     assert all(s == "pass" for s in statuses.values()), statuses
     # both engines actually ran, incl. the fsdp rules (ISSUE 7), the
     # serving decode-step rules (ISSUE 10), the elastic census pins in
     # BOTH directions (ISSUEs 11 + 12), the 2-D TP x FSDP rules
-    # (ISSUE 13), and the two-tier hier wire rules (ISSUE 16)
+    # (ISSUE 13), the two-tier hier wire rules (ISSUE 16), and the paged
+    # serving pool donation rule (ISSUE 17)
     kinds = {r for r in report["rules_run"]}
     assert "shard-map-shim-only" in kinds and "zero1-collectives" in kinds
     assert "fsdp-layer-gather-bound" in kinds
@@ -43,6 +45,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "elastic-grow-census" in kinds
     assert "tp-psum-signature" in kinds
     assert "hier-tier-signature" in kinds
+    assert "paged-pool-donated" in kinds
     assert "fsdp-gather-rides-data-only" in kinds
     assert "span-names-registered" in kinds
     assert "profiler-session-via-stepprofiler-only" in kinds

@@ -1,0 +1,670 @@
+"""serving/ — token-granular continuous batching + paged int8 KV +
+multi-replica router (ISSUE 17).
+
+Pins, in order:
+* `PagePool` allocator semantics: refcounts, prefix sharing, LRU
+  eviction of retained prefix pages, admission-control failure (None,
+  nothing leaked);
+* SlotEngine greedy decode is BITWISE the solo full-context forward for
+  mixed-length requests, with joins/leaves at token granularity
+  (per-request ``max_new_tokens`` completing mid-batch);
+* zero recompiles after warmup across >= 20 mixed-length admissions;
+* sampling determinism: the emitted stream is a function of (request,
+  seed) alone — slot assignment, join order, and batch company are
+  invisible; ``temperature=0`` is bitwise greedy;
+* the int8 paged pool cuts KV bytes >= 3x vs the dense fp32 baseline and
+  quantizes deterministically (same request -> same tokens, twice);
+* `slot_wait` / `router_dispatch` spans + the slot-occupancy / page-pool
+  gauges are registered span names, emitted live, and bucketed by
+  `telemetry summary` into the step-time split (not "unaccounted");
+* the ``serving_paged`` contract + `paged-pool-donated` rule,
+  mutation-tested per the checker's own standard;
+* the fleet acceptance drill: 20+ mixed-length requests over 2
+  router-fronted replicas on DISJOINT device slices, one replica killed
+  with work in flight — every request completes (seed-pinned resubmit),
+  zero recompiles on either engine, outputs bitwise the solo forwards;
+* scheduler kill fails queued-but-unpulled requests too (no orphaned
+  waiters), and the router unit semantics (least-depth, resubmit).
+"""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu import telemetry
+from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
+from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+from distributed_pytorch_training_tpu.serving.batching import RequestQueue
+from distributed_pytorch_training_tpu.serving.continuous import (
+    ContinuousScheduler, SlotEngine, sample_tokens,
+)
+from distributed_pytorch_training_tpu.serving.paged import (
+    PagedServeConfig, PagePool,
+)
+from distributed_pytorch_training_tpu.serving.router import (
+    InProcessReplica, ReplicaDead, Router, RouterRequest,
+)
+
+VOCAB = 97
+
+
+def tiny_model(**kw):
+    cfg = dict(vocab_size=VOCAB, hidden_dim=32, depth=2, num_heads=2,
+               max_position=64)
+    cfg.update(kw)
+    return GPT2LMHead(**cfg)
+
+
+@pytest.fixture(scope="module")
+def tiny(mesh8):
+    model = tiny_model()
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+                        train=False)["params"]
+    return model, params
+
+
+def paged_cfg(**kw):
+    cfg = dict(buckets=(8, 16), rows=8, max_new_tokens=6, page_size=4)
+    cfg.update(kw)
+    return PagedServeConfig(**cfg)
+
+
+@pytest.fixture(scope="module")
+def slot_engine(mesh8, tiny):
+    model, params = tiny
+    eng = SlotEngine(model, mesh8, paged_cfg(), params)
+    eng.warmup()
+    return eng
+
+
+def prompts(ns, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, n).astype(np.int32) for n in ns]
+
+
+_REF_PAD = 32          # >= longest prompt (16) + max_new_tokens (6)
+_ref_fwd_cache: dict = {}
+
+
+def ref_greedy(model, params, prompt, n):
+    """The solo reference: greedy continuation off the full-context eval
+    forward (test_serving.py's bitwise anchor, extended to a token loop).
+    The forward is jitted at ONE fixed padded length so every reference
+    decode in the file shares a single compile — the model is causal, so
+    trailing pad cannot reach position cur-1, and the emitted argmax
+    stream is identical to the per-length eager forward's (the float
+    logits differ only by ~1e-7 fusion-order noise, which the pin — the
+    TOKEN stream — does not see)."""
+    fwd = _ref_fwd_cache.get(id(model))
+    if fwd is None:
+        fwd = jax.jit(lambda p, ids: model.apply({"params": p}, ids,
+                                                 train=False))
+        _ref_fwd_cache[id(model)] = fwd
+    ids = np.zeros((1, _REF_PAD), np.int32)
+    ids[0, :len(prompt)] = prompt
+    cur = len(prompt)
+    out = []
+    for _ in range(n):
+        logits = fwd(params, jnp.asarray(ids))
+        nxt = int(jnp.argmax(logits[0, cur - 1]))
+        out.append(nxt)
+        ids[0, cur] = nxt
+        cur += 1
+    return np.asarray(out, np.int32)
+
+
+def serve_all(engine, specs, timeout=300.0):
+    """Reset the engine, push every spec through a fresh scheduler, drain,
+    and return the per-request Results in submission order. ``specs`` are
+    (tokens, kw) pairs for RequestQueue.submit."""
+    engine.reset_state()
+    q = RequestQueue(engine.config.buckets)
+    sched = ContinuousScheduler(engine, q)
+    reqs = [q.submit(toks, **kw) for toks, kw in specs]
+    sched.drain()
+    return [r.result(timeout=timeout) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# PagePool: the host-side allocator
+# ---------------------------------------------------------------------------
+
+
+class TestPagePool:
+    def test_scratch_page_never_leased(self):
+        pool = PagePool(9, 4, 4, prefix_sharing=False)
+        lease = pool.alloc(list(range(6)), 8)
+        assert lease is not None and lease.n_pages == 2
+        assert 0 not in lease.pages[:lease.n_pages]
+        # unused table entries point at scratch page 0
+        assert all(p == 0 for p in lease.pages[lease.n_pages:])
+
+    def test_release_returns_pages(self):
+        pool = PagePool(9, 4, 4, prefix_sharing=False)
+        free0 = pool.free_pages()
+        lease = pool.alloc(list(range(6)), 8)
+        assert pool.free_pages() == free0 - 2
+        pool.release(lease)
+        assert pool.free_pages() == free0
+
+    def test_prefix_sharing_maps_same_pages(self):
+        pool = PagePool(17, 4, 4)
+        toks = list(range(11))          # pages 0..1 fully covered
+        a = pool.alloc(toks, 13)
+        b = pool.alloc(toks, 13)
+        assert a is not None and b is not None
+        # the fully-covered prompt pages are the SAME physical pages
+        np.testing.assert_array_equal(a.pages[:2], b.pages[:2])
+        # the partial tail page is private to each lease
+        assert a.pages[2] != b.pages[2]
+        assert b.shared == list(a.pages[:2]) and pool.prefix_hits == 2
+
+    def test_divergent_prompts_do_not_share(self):
+        pool = PagePool(17, 4, 4)
+        a = pool.alloc(list(range(8)), 8)
+        b = pool.alloc(list(range(1, 9)), 8)
+        assert set(map(int, a.pages[:2])).isdisjoint(
+            set(map(int, b.pages[:2])))
+
+    def test_lru_eviction_of_retained_prefix(self):
+        # 4 physical pages (1 scratch + 3): a released prefix page parks
+        # retained; exhausting the free list evicts it (oldest first)
+        pool = PagePool(4, 4, 3)
+        a = pool.alloc(list(range(4)), 4)      # 1 fully-covered page
+        pool.release(a)
+        assert pool.stats()["retained"] == 1
+        b = pool.alloc(list(range(100, 112)), 12)   # needs all 3 pages
+        assert b is not None and pool.evictions == 1
+        assert pool.stats()["retained"] == 0
+
+    def test_alloc_failure_leaks_nothing(self):
+        pool = PagePool(4, 4, 8, prefix_sharing=False)
+        free0 = pool.free_pages()
+        assert pool.alloc(list(range(4)), 17) is None   # needs 5 > 3 pages
+        assert pool.free_pages() == free0
+
+    def test_config_validation_and_floor(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            paged_cfg(kv_dtype="fp8")
+        with pytest.raises(ValueError, match="page_size"):
+            paged_cfg(page_size=0)
+        cfg = paged_cfg()
+        assert cfg.cache_len == 16 + 6
+        assert cfg.pages_per_slot == 6           # ceil(22 / 4)
+        assert cfg.total_pages == 8 * 6 + 1      # fail-safe floor + scratch
+
+
+# ---------------------------------------------------------------------------
+# SlotEngine: greedy bitwise parity + the zero-recompile census
+# ---------------------------------------------------------------------------
+
+
+class TestSlotEngineGreedy:
+    def test_mixed_lengths_match_solo_forward_bitwise(self, slot_engine,
+                                                      tiny):
+        model, params = tiny
+        seqs = prompts((3, 8, 11, 16, 5, 13), seed=1)
+        res = serve_all(slot_engine,
+                        [(s, dict(temperature=0.0)) for s in seqs])
+        for i, (s, r) in enumerate(zip(seqs, res)):
+            np.testing.assert_array_equal(
+                r.tokens, ref_greedy(model, params, s, 6),
+                err_msg=f"request {i} (len {len(s)})")
+
+    def test_token_granular_join_leave(self, slot_engine, tiny):
+        """Per-request budgets: rows leave the RUNNING batch the moment
+        their own want is met (batch-mates keep decoding), and each
+        stream is still the bitwise solo greedy prefix."""
+        model, params = tiny
+        seqs = prompts((4, 9, 6, 12, 7), seed=2)
+        wants = [1, 6, 3, 5, 2]
+        res = serve_all(slot_engine,
+                        [(s, dict(temperature=0.0, max_new_tokens=w))
+                         for s, w in zip(seqs, wants)])
+        for s, w, r in zip(seqs, wants, res):
+            assert r.tokens.shape == (w,)
+            np.testing.assert_array_equal(
+                r.tokens, ref_greedy(model, params, s, w))
+
+    def test_zero_recompiles_after_warmup(self, slot_engine):
+        rng = np.random.RandomState(5)
+        before = slot_engine.compiles
+        specs = [(rng.randint(0, VOCAB, int(rng.randint(1, 17)))
+                  .astype(np.int32),
+                  dict(temperature=0.0,
+                       max_new_tokens=int(rng.randint(1, 7))))
+                 for _ in range(22)]
+        res = serve_all(slot_engine, specs)
+        assert len(res) == 22 and all(r.tokens.size for r in res)
+        assert slot_engine.compiles == before, \
+            "an admission or decode step recompiled after warmup"
+
+    def test_last_logits_match_eval_forward(self, slot_engine, tiny):
+        """The compiled prefill's last-prompt logits agree with the eval
+        forward to fusion-order noise (~1e-7 — the compiled (1, bucket)
+        program fuses differently than the solo-shaped forward), and the
+        emitted token IS their argmax — the bitwise pin lives on the
+        token stream, not the float intermediates."""
+        model, params = tiny
+        (s,) = prompts((9,), seed=3)
+        (r,) = serve_all(slot_engine, [(s, dict(temperature=0.0))])
+        solo = np.asarray(
+            model.apply({"params": params}, s[None],
+                        train=False))[0, len(s) - 1]
+        np.testing.assert_allclose(r.last_logits, solo, rtol=1e-5,
+                                   atol=1e-6)
+        assert int(r.tokens[0]) == int(np.argmax(r.last_logits))
+        assert int(r.tokens[0]) == int(np.argmax(solo))
+
+
+# ---------------------------------------------------------------------------
+# Sampling determinism (the RNG-threading satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestSamplingDeterminism:
+    def test_temperature_zero_is_argmax(self):
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(5, VOCAB), jnp.float32)
+        keys = jnp.stack([jax.random.PRNGKey(i) for i in range(5)])
+        toks = sample_tokens(logits, keys, jnp.zeros(5), jnp.ones(5))
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.argmax(np.asarray(logits), -1))
+
+    def test_stream_ignores_slots_join_order_and_company(self, slot_engine,
+                                                         tiny):
+        """Same (prompt, seed, knobs) -> identical tokens whether the
+        request runs alone, joins last behind one crowd, or first ahead
+        of a different one — slot index and batch-mates are invisible."""
+        (target,) = prompts((7,), seed=10)
+        t_kw = dict(temperature=0.8, top_p=0.9, seed=1234,
+                    max_new_tokens=6)
+        decoys_a = [(s, dict(temperature=1.0, seed=50 + i,
+                             max_new_tokens=3 + i % 4))
+                    for i, s in enumerate(prompts((5, 12, 3, 9, 15, 6, 4),
+                                                  seed=11))]
+        decoys_b = [(s, dict(temperature=0.0, max_new_tokens=2 + i % 5))
+                    for i, s in enumerate(prompts((14, 2, 8, 10), seed=12))]
+        alone = serve_all(slot_engine, [(target, t_kw)])[0]
+        last = serve_all(slot_engine, decoys_a + [(target, t_kw)])[-1]
+        first = serve_all(slot_engine, [(target, t_kw)] + decoys_b)[0]
+        np.testing.assert_array_equal(alone.tokens, last.tokens)
+        np.testing.assert_array_equal(alone.tokens, first.tokens)
+
+    def test_distinct_seeds_diverge(self, slot_engine):
+        (s,) = prompts((8,), seed=13)
+        kw = dict(temperature=1.0, top_p=1.0, max_new_tokens=6)
+        a, b = serve_all(slot_engine, [(s, dict(seed=1, **kw)),
+                                       (s, dict(seed=2, **kw))])
+        assert not np.array_equal(a.tokens, b.tokens)
+
+
+# ---------------------------------------------------------------------------
+# int8 pages: the HBM cut + deterministic quantization
+# ---------------------------------------------------------------------------
+
+
+class TestInt8Pages:
+    @pytest.fixture(scope="class")
+    def int8_engine(self, mesh8):
+        # head_dim 32 (the smallest real-model head width — gpt2 heads
+        # are 64): the per-(row, head) fp32 scale amortizes over the head
+        # dim, so the >= 3x cut needs real head widths; the depth-2
+        # hidden-32 toy's head_dim 16 pays 25% scale overhead and lands
+        # at ~2.9x, which is the honest accounting, not a miss
+        model = tiny_model(hidden_dim=64)
+        params = model.init(jax.random.PRNGKey(0),
+                            np.zeros((1, 8), np.int32),
+                            train=False)["params"]
+        # one bucket: these tests pin bytes + determinism, not bucket
+        # routing (TestSlotEngineGreedy owns that), and each extra
+        # bucket is a whole extra prefill compile at hidden 64
+        eng = SlotEngine(model, mesh8,
+                         paged_cfg(buckets=(16,), kv_dtype="int8"), params)
+        eng.warmup()
+        return eng
+
+    def test_byte_ratio_at_least_3x(self, int8_engine):
+        ratio = (int8_engine.dense_baseline_bytes()
+                 / int8_engine.paged_bytes())
+        assert ratio >= 3.0, f"int8 paged/dense byte ratio {ratio:.2f} < 3"
+
+    def test_quantization_is_deterministic(self, int8_engine):
+        """The wire-codec grid story: serving the same requests twice
+        (fresh pool each time) emits identical tokens — the int8
+        perturbation is a deterministic function of the values, so every
+        replica agrees (the router's resubmit-invisibility premise)."""
+        seqs = prompts((6, 11, 4), seed=14)
+        specs = [(s, dict(temperature=0.0)) for s in seqs]
+        first = serve_all(int8_engine, specs)
+        second = serve_all(int8_engine, specs)
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a.tokens, b.tokens)
+            np.testing.assert_array_equal(a.last_logits, b.last_logits)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: registered spans, live gauges, summary bucketing
+# ---------------------------------------------------------------------------
+
+
+class TestServingTelemetry:
+    def test_span_names_registered(self):
+        from distributed_pytorch_training_tpu.telemetry.recorder import (
+            REGISTERED_SPAN_NAMES, SERVING_SPAN_NAMES,
+        )
+
+        assert {"slot_wait", "router_dispatch"} <= set(SERVING_SPAN_NAMES)
+        assert {"slot_wait", "router_dispatch"} <= set(
+            REGISTERED_SPAN_NAMES)
+
+    def test_spans_and_gauges_emitted_and_bucketed(self, slot_engine):
+        """A routed serve emits slot_wait + router_dispatch spans and the
+        occupancy/page-pool gauges; `telemetry summary` folds the spans
+        into the step-time split instead of "unaccounted"."""
+        from distributed_pytorch_training_tpu.telemetry.__main__ import (
+            summarize,
+        )
+
+        slot_engine.reset_state()
+        rec = telemetry.configure()          # ring-only stream
+        try:
+            replica = InProcessReplica("r0", slot_engine)
+            router = Router([replica])
+            reqs = [router.submit(s, temperature=0.0)
+                    for s in prompts((5, 9, 12), seed=15)]
+            for r in reqs:
+                r.result(timeout=120.0)
+            replica.stop()
+            events = rec.tail(10_000)
+        finally:
+            telemetry.reset()
+        names = {e["name"] for e in events if e["kind"] == "span"}
+        assert {"slot_wait", "router_dispatch", "prefill"} <= names
+        gauges = {e["name"] for e in events if e["kind"] == "gauge"}
+        assert {"serving_slot_occupancy", "serving_page_pool_free",
+                "serving_queue_depth"} <= gauges
+        summary = summarize(events)
+        assert "slot_wait" in summary["spans"]
+        assert "router_dispatch" in summary["spans"]
+        # the split accounts the serving phases by name (a typo'd name
+        # would vanish into "unaccounted"); synthetic durations keep the
+        # assertion robust to microsecond real spans rounding to 0
+        synth = summarize([
+            {"kind": "span", "name": n, "dur_ms": 5.0}
+            for n in ("slot_wait", "router_dispatch")])
+        assert set(synth["step_split_pct"]) == {"slot_wait",
+                                                "router_dispatch"}
+
+
+# ---------------------------------------------------------------------------
+# The serving_paged contract + paged-pool-donated rule (mutation-tested)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedContract:
+    def test_contract_passes_on_mesh(self, mesh8):
+        from distributed_pytorch_training_tpu.analysis.contracts import (
+            get_contract,
+        )
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            check_artifacts, evaluate_contract,
+        )
+
+        contract = get_contract("serving_paged")
+        # the matrix pins the int8 arm — the most droppable leaves
+        assert contract.config.get("paged_kv_dtype") == "int8"
+        artifacts = evaluate_contract(contract, mesh=mesh8)
+        # layer-stacked pool: 4 int8 leaves (codes + scales), NOT x depth
+        assert artifacts.config["paged_cache_leaves"] == 4
+        findings = check_artifacts(artifacts)
+        assert findings == [], [str(f) for f in findings]
+
+    def test_live_engine_artifacts_pass(self, slot_engine):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            check_artifacts, paged_serving_artifacts,
+        )
+
+        artifacts = paged_serving_artifacts(slot_engine)
+        assert artifacts.config["paged_cache_leaves"] == 2  # fp32 k/v
+        assert check_artifacts(artifacts) == []
+
+    def test_mutation_missing_alias_entries_flag(self):
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            StepArtifacts, check_artifacts,
+        )
+
+        partial = StepArtifacts(
+            name="mut", optimized_text=(
+                "HloModule paged, input_output_alias={ {0}: (1, {}, "
+                "may-alias) }, entry_computation_layout={()}"),
+            config={"serving_paged": True, "donate_state": True,
+                    "paged_cache_leaves": 4})
+        found = check_artifacts(partial, rules=["paged-pool-donated"])
+        assert len(found) == 1 and "1 of the >= 4" in found[0].message
+        absent = StepArtifacts(
+            name="mut2", optimized_text="HloModule paged",
+            config={"serving_paged": True, "donate_state": True,
+                    "paged_cache_leaves": 2})
+        assert check_artifacts(absent, rules=["paged-pool-donated"])
+        train = StepArtifacts(name="t", optimized_text="HloModule x",
+                              config={"donate_state": False})
+        assert check_artifacts(train, rules=["paged-pool-donated"]) == []
+
+    def test_mutation_dropped_leaf_flags(self, slot_engine):
+        """Raising the census above the real table simulates one pool
+        leaf falling out of the alias set — the rule must fire on the
+        REAL lowering, not only on synthetic text."""
+        import dataclasses as dc
+
+        from distributed_pytorch_training_tpu.analysis.hlo_rules import (
+            check_artifacts, paged_serving_artifacts,
+        )
+
+        artifacts = paged_serving_artifacts(slot_engine)
+        poisoned = dc.replace(
+            artifacts, config={**artifacts.config,
+                               "paged_cache_leaves":
+                               artifacts.config["paged_cache_leaves"]
+                               + 100})
+        found = check_artifacts(poisoned, rules=["paged-pool-donated"])
+        assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# Router unit semantics (no devices)
+# ---------------------------------------------------------------------------
+
+
+class _StubPending:
+    def __init__(self, replica, fail_first=False):
+        self.replica = replica
+        self.fail = fail_first
+
+    def result(self, timeout=None):
+        if self.fail or self.replica.dead:
+            raise ReplicaDead(f"replica {self.replica.name} died")
+        from distributed_pytorch_training_tpu.serving.batching import Result
+
+        return Result(tokens=np.zeros(1, np.int32),
+                      last_logits=np.zeros(VOCAB, np.float32))
+
+
+class _StubReplica:
+    def __init__(self, name, depth=0):
+        self.name = name
+        self.depth = depth
+        self.dead = False
+        self.submits = []
+
+    def healthy(self):
+        return not self.dead
+
+    def queue_depth(self):
+        return self.depth
+
+    def submit(self, tokens, **kw):
+        if self.dead:
+            raise ReplicaDead(f"replica {self.name} is down")
+        self.submits.append(kw)
+        return _StubPending(self)
+
+
+class TestRouterUnits:
+    def test_least_depth_wins(self):
+        a, b = _StubReplica("a", depth=5), _StubReplica("b", depth=1)
+        router = Router([a, b])
+        for _ in range(3):
+            router.submit(np.ones(4, np.int32)).result(timeout=1.0)
+        assert len(b.submits) == 3 and not a.submits
+
+    def test_seed_pinned_at_route_time_and_survives_resubmit(self):
+        a, b = _StubReplica("a"), _StubReplica("b")
+        router = Router([a, b])
+        req = router.submit(np.ones(4, np.int32))
+        seed = req.kw["seed"]
+        assert seed is not None
+        first = req.replica_name
+        req._inner.fail = True            # the dispatched copy dies
+        router.replicas[first].dead = True
+        req.result(timeout=1.0)           # resubmits to the survivor
+        assert req.replica_deaths == 1 and req.replica_name != first
+        survivor = router.replicas[req.replica_name]
+        assert survivor.submits[-1]["seed"] == seed
+
+    def test_distinct_requests_get_distinct_seeds(self):
+        router = Router([_StubReplica("a")])
+        r1 = router.submit(np.ones(4, np.int32))
+        r2 = router.submit(np.ones(4, np.int32))
+        assert r1.kw["seed"] != r2.kw["seed"]
+
+    def test_no_healthy_replicas_raises(self):
+        a = _StubReplica("a")
+        a.dead = True
+        router = Router([a])
+        with pytest.raises(ReplicaDead, match="no healthy"):
+            router.submit(np.ones(4, np.int32))
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="unique"):
+            Router([_StubReplica("a"), _StubReplica("a")])
+
+
+# ---------------------------------------------------------------------------
+# Scheduler kill: nothing hangs
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerKill:
+    def test_kill_fails_queued_pending_and_running(self, slot_engine):
+        """An injected death resolves EVERY accepted request — including
+        the ones still parked in the queue (an abandoned queue entry
+        would hang its waiter forever; the router needs the error to
+        resubmit)."""
+        slot_engine.reset_state()
+        q = RequestQueue(slot_engine.config.buckets)
+        sched = ContinuousScheduler(slot_engine, q)
+        reqs = [q.submit(s, temperature=0.0)
+                for s in prompts((4, 7, 10), seed=16)]
+        failed = sched.kill()
+        assert len(failed) == 3
+        for r in reqs:
+            with pytest.raises(RuntimeError, match="died"):
+                r.result(timeout=5.0)
+        # the queue refuses new work after the death
+        with pytest.raises(RuntimeError):
+            q.submit(np.ones(4, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# The fleet acceptance drill: 2 replicas, 1 death, all bitwise
+# ---------------------------------------------------------------------------
+
+
+class TestFleetAcceptance:
+    @pytest.fixture(scope="class")
+    def fleet_engines(self, devices, tiny):
+        """Two SlotEngines on DISJOINT 4-device slices — the fleet
+        topology (replicas do not share chips), and a hard in-process
+        requirement: the row-sharded decode carries collectives, and two
+        scheduler threads dispatching collective programs over
+        OVERLAPPING device sets deadlock the CPU rendezvous."""
+        model, params = tiny
+        engines = []
+        for i in range(2):
+            mesh = build_mesh(MeshSpec(data=4),
+                              devices=devices[i * 4:(i + 1) * 4])
+            eng = SlotEngine(model, mesh, paged_cfg(), params)
+            eng.warmup()
+            engines.append(eng)
+        return engines
+
+    def test_fleet_kill_all_complete_bitwise(self, fleet_engines, tiny):
+        model, params = tiny
+        eng_a, eng_b = fleet_engines
+        warm = (eng_a.compiles, eng_b.compiles)
+        ra = InProcessReplica("r0", eng_a)
+        rb = InProcessReplica("r1", eng_b)
+        router = Router([ra, rb])
+        rng = np.random.RandomState(7)
+        seqs = [rng.randint(0, VOCAB, int(rng.randint(1, 17)))
+                .astype(np.int32) for _ in range(22)]
+        reqs = [router.submit(s, temperature=0.0, max_new_tokens=6)
+                for s in seqs]
+        # the death must land with work IN FLIGHT on r0: submission is
+        # instant and service is not, so depth > 0 immediately
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline and ra.queue_depth() == 0:
+            time.sleep(0.001)
+        assert ra.queue_depth() > 0, "r0 never held work to kill"
+        failed = ra.kill()
+        assert failed, "the kill found nothing in flight"
+        results = [r.result(timeout=300.0) for r in reqs]
+
+        assert len(results) == 22
+        assert sum(r.replica_deaths for r in reqs) >= 1
+        assert not ra.healthy() and rb.healthy()
+        # zero recompiles on BOTH engines, through death and resubmission
+        assert (eng_a.compiles, eng_b.compiles) == warm
+        # every stream bitwise the solo full-context greedy forward —
+        # resubmission is invisible in the output
+        for i, (s, res) in enumerate(zip(seqs, results)):
+            np.testing.assert_array_equal(
+                res.tokens, ref_greedy(model, params, s, 6),
+                err_msg=f"request {i} (len {len(s)}, "
+                        f"deaths {reqs[i].replica_deaths})")
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# The CLI bench arm (slow: subprocess e2e)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_bench_continuous_exits_zero(tmp_path):
+    """`serving bench --continuous --mixed-want` runs the offered-load
+    row end to end and exits 0 iff recompiles_after_warmup == 0 (the
+    hard gate the fleet bench arms reuse)."""
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_pytorch_training_tpu.serving", "bench",
+         "--continuous", "--mixed-want",
+         "--model", "gpt2_124m",
+         "--model-overrides", "hidden_dim=32,depth=2,num_heads=2",
+         "--buckets", "8,16", "--rows", "8", "--max-new-tokens", "4",
+         "--requests", "8", "--offered-load", "16",
+         "--output-dir", str(tmp_path / "out")],
+        env=env, cwd=str(Path(__file__).resolve().parent.parent),
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr
