@@ -461,6 +461,12 @@ class ContinuousScheduler:
         self._t_popped: Dict[int, float] = {}
         self.served = 0
         self.killed = False
+        # serializes step() against kill(): kill runs on the CALLER's
+        # thread (InProcessReplica.kill) while the worker is mid-step,
+        # and without the lock it races the running/pending iteration
+        # (dict changed size) and can double-resolve a request that is
+        # completing at the instant of death
+        self._lock = threading.Lock()
         # max decode steps per fence when nothing is waiting to join
         # (see step()); 1 restores strict fence-per-token behavior
         self.burst_steps = 4
@@ -588,25 +594,33 @@ class ContinuousScheduler:
         burst never delays a completion, and a request arriving mid-burst
         waits at most `burst_steps` tokens for admission (the
         token-granularity bound, traded explicitly for fewer host-device
-        round trips on long decodes)."""
-        self._pull()
-        self._admit_pending()
-        if self.running:
-            steps = 1
-            if not self.pending and not len(self.queue):
-                steps = max(1, min(min(st.left for st in
-                                       self.running.values()),
-                                   self.burst_steps))
-            self._step_decode_loop(steps)
-            jax.block_until_ready(self.engine._control["tok"])
-            # the fence proves every dispatched prefill's token #0 landed:
-            # the honest (if slightly late) time-to-first-token stamp
-            now = time.perf_counter()
-            for st in self.running.values():
-                if st.req.t_first_token is None:
-                    st.req.t_first_token = now
-            self._complete_finished()
-        return bool(self.running or self.pending)
+        round trips on long decodes).
+
+        The whole iteration runs under the scheduler lock: `kill` (the
+        caller-thread chaos hook) waits for the step boundary, so it can
+        never mutate running/pending mid-iteration or error a request
+        this step is concurrently completing."""
+        with self._lock:
+            if self.killed:
+                return False
+            self._pull()
+            self._admit_pending()
+            if self.running:
+                steps = 1
+                if not self.pending and not len(self.queue):
+                    steps = max(1, min(min(st.left for st in
+                                           self.running.values()),
+                                       self.burst_steps))
+                self._step_decode_loop(steps)
+                jax.block_until_ready(self.engine._control["tok"])
+                # the fence proves every dispatched prefill's token #0
+                # landed: the honest (if slightly late) TTFT stamp
+                now = time.perf_counter()
+                for st in self.running.values():
+                    if st.req.t_first_token is None:
+                        st.req.t_first_token = now
+                self._complete_finished()
+            return bool(self.running or self.pending)
 
     def run(self, stop: threading.Event, log=None) -> int:
         """Serve until ``stop`` is set AND everything accepted has
@@ -636,26 +650,32 @@ class ContinuousScheduler:
     def kill(self, err: Optional[BaseException] = None) -> List[Request]:
         """Chaos hook: fail every in-flight, pending, AND still-queued
         request (the injected replica death). Returns the failed requests
-        — the router resubmits them to surviving replicas."""
-        self.killed = True
-        err = err or RuntimeError("replica died")
-        failed: List[Request] = []
-        for st in self.running.values():
-            st.req.set_error(err)
-            failed.append(st.req)
-        for req in self.pending:
-            req.set_error(err)
-            failed.append(req)
-        # accepted-but-unpulled requests die with the replica too: left
-        # parked in the closed queue they would hang their waiters forever
-        # (no worker remains to pull them)
-        self.queue.close()
-        for req in self.queue.take(len(self.queue) + 1, timeout=0.0):
-            req.set_error(err)
-            failed.append(req)
-        self.running.clear()
-        self.pending.clear()
-        return failed
+        — the router resubmits them to surviving replicas.
+
+        Runs under the scheduler lock, so the death lands at a step
+        boundary: requests the in-flight step already completed are out
+        of `running` (resolved exactly once, as results), everything
+        else fails here exactly once."""
+        with self._lock:
+            self.killed = True
+            err = err or RuntimeError("replica died")
+            failed: List[Request] = []
+            for st in self.running.values():
+                st.req.set_error(err)
+                failed.append(st.req)
+            for req in self.pending:
+                req.set_error(err)
+                failed.append(req)
+            # accepted-but-unpulled requests die with the replica too:
+            # left parked in the closed queue they would hang their
+            # waiters forever (no worker remains to pull them)
+            self.queue.close()
+            for req in self.queue.take(len(self.queue) + 1, timeout=0.0):
+                req.set_error(err)
+                failed.append(req)
+            self.running.clear()
+            self.pending.clear()
+            return failed
 
 
 def serve_continuous(engine: SlotEngine, queue: RequestQueue,

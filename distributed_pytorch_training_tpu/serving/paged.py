@@ -174,6 +174,13 @@ class PagePool:
                 page = self._by_hash.get(h)
                 if page is None:
                     break   # prefix diverges from here on: fresh pages
+                # claim AT MATCH TIME: refcount bump + unpark, so a dry
+                # free list can never evict a just-matched refcount-0
+                # retained page and re-lease it as a fresh page (the
+                # same physical page at two logical offsets would let
+                # the prefill scatter corrupt the shared prefix)
+                self._ref[page] = self._ref.get(page, 0) + 1
+                self._retained.pop(page, None)  # leased: not evictable
                 pages.append(page)
                 shared.append(page)
             fresh_start = len(pages)
@@ -187,10 +194,11 @@ class PagePool:
             if not ok:      # roll back: nothing leased on failure
                 for page in pages[fresh_start:]:
                     self._free.append(page)
+                for page in shared:
+                    self._release_page(page)  # re-parks retained prefixes
                 return None
-            for page in pages:
+            for page in pages[fresh_start:]:
                 self._ref[page] = self._ref.get(page, 0) + 1
-                self._retained.pop(page, None)  # leased: not evictable
             self.prefix_hits += len(shared)
             # register the fresh fully-covered prompt pages for future
             # sharing (the tail/decode pages carry no hash by design)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import re
+import socket
 import threading
 import time
 import urllib.error
@@ -170,7 +171,20 @@ class _HttpPending:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.replica.timeout_s) as resp:
                 out = json.loads(resp.read().decode())
+        except (TimeoutError, socket.timeout) as e:
+            # a slow read is NOT a death: the replica is healthy but
+            # busy, and resubmitting would stack a duplicate in-flight
+            # copy on it — surface the timeout to the caller instead
+            raise TimeoutError(
+                f"replica {self.replica.name}: no response within "
+                f"{timeout or self.replica.timeout_s}s") from e
         except (OSError, urllib.error.URLError) as e:
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, (TimeoutError, socket.timeout)):
+                raise TimeoutError(
+                    f"replica {self.replica.name}: no response within "
+                    f"{timeout or self.replica.timeout_s}s") from e
+            # connection refused/reset: the process is actually gone
             self.replica._last_ok = False
             raise ReplicaDead(
                 f"replica {self.replica.name}: {e}") from e
@@ -223,17 +237,31 @@ class RouterRequest:
         deadline = None if timeout is None else \
             time.perf_counter() + timeout
         while True:
-            left = None if deadline is None else \
-                max(0.1, deadline - time.perf_counter())
+            left = None
+            if deadline is not None:
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"routed request timed out after {timeout}s "
+                        f"({self.replica_deaths} replica deaths)")
             try:
                 res = self._inner.result(timeout=left)
                 self.t_done = getattr(self._inner, "t_done", None) \
                     or time.perf_counter()
                 return res
-            except ReplicaDead:
+            except ReplicaDead as e:
                 # the replica died with our request in flight: resubmit
                 # to the survivors (same seed -> same tokens, so the
-                # retry is invisible in the output stream)
+                # retry is invisible in the output stream) — but only
+                # while the caller's deadline still has room; a spent
+                # deadline must raise, not spin resubmitting forever.
+                # A plain slow read raises TimeoutError (not
+                # ReplicaDead) and propagates: slow is not dead.
+                if deadline is not None and \
+                        time.perf_counter() >= deadline:
+                    raise TimeoutError(
+                        f"routed request timed out after {timeout}s "
+                        f"({self.replica_deaths} replica deaths)") from e
                 self.replica_deaths += 1
                 dead = self.replica_name
                 self._dispatch(exclude=(dead,) if dead else ())
@@ -255,20 +283,26 @@ class Router:
         self._lock = threading.Lock()
 
     def _pick(self, exclude: Sequence[str] = ()):
+        # snapshot under the lock, PROBE outside it: healthy() and
+        # queue_depth() are HTTP round trips for HttpReplica (2s timeout
+        # each), and holding the router lock across them would let one
+        # unreachable replica serialize every dispatch on every thread
         with self._lock:
-            live = [r for name, r in self.replicas.items()
-                    if name not in exclude and r.healthy()]
-            if not live:
-                # second chance for the excluded (a lone restarted
-                # replica beats failing the request outright)
-                live = [r for r in self.replicas.values() if r.healthy()]
-            if not live:
-                raise ReplicaDead("no healthy replicas")
             self._rr += 1
-            depths = [(r.queue_depth(), i) for i, r in enumerate(live)]
-            best = min(d for d, _ in depths)
-            candidates = [i for d, i in depths if d == best]
-            return live[candidates[self._rr % len(candidates)]]
+            rr = self._rr
+            replicas = list(self.replicas.values())
+        live = [r for r in replicas
+                if r.name not in exclude and r.healthy()]
+        if not live:
+            # second chance for the excluded (a lone restarted
+            # replica beats failing the request outright)
+            live = [r for r in replicas if r.healthy()]
+        if not live:
+            raise ReplicaDead("no healthy replicas")
+        depths = [(r.queue_depth(), i) for i, r in enumerate(live)]
+        best = min(d for d, _ in depths)
+        candidates = [i for d, i in depths if d == best]
+        return live[candidates[rr % len(candidates)]]
 
     def submit(self, tokens: np.ndarray, **kw) -> RouterRequest:
         return RouterRequest(self, tokens, kw)

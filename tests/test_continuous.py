@@ -27,9 +27,13 @@ Pins, in order:
   waiters), and the router unit semantics (least-depth, resubmit).
 """
 
+import collections
+import socket
 import subprocess
 import sys
+import threading
 import time
+import urllib.error
 from pathlib import Path
 
 import jax
@@ -40,6 +44,7 @@ import pytest
 from distributed_pytorch_training_tpu import telemetry
 from distributed_pytorch_training_tpu.models.gpt2 import GPT2LMHead
 from distributed_pytorch_training_tpu.parallel import MeshSpec, build_mesh
+from distributed_pytorch_training_tpu.serving import batching
 from distributed_pytorch_training_tpu.serving.batching import RequestQueue
 from distributed_pytorch_training_tpu.serving.continuous import (
     ContinuousScheduler, SlotEngine, sample_tokens,
@@ -48,7 +53,7 @@ from distributed_pytorch_training_tpu.serving.paged import (
     PagedServeConfig, PagePool,
 )
 from distributed_pytorch_training_tpu.serving.router import (
-    InProcessReplica, ReplicaDead, Router, RouterRequest,
+    HttpReplica, InProcessReplica, ReplicaDead, Router, RouterRequest,
 )
 
 VOCAB = 97
@@ -188,6 +193,30 @@ class TestPagePool:
         free0 = pool.free_pages()
         assert pool.alloc(list(range(4)), 17) is None   # needs 5 > 3 pages
         assert pool.free_pages() == free0
+
+    def test_dry_free_list_never_duplicates_matched_prefix(self):
+        # free list dry + the matched prefix page parked retained at
+        # refcount 0: alloc must claim the match at match time, not
+        # evict it in the fresh-page loop and re-lease it — one physical
+        # page at two logical offsets would let the prefill scatter
+        # corrupt the shared prefix
+        pool = PagePool(3, 4, 2)               # scratch + pages {1, 2}
+        a = pool.alloc(list(range(4)), 4)      # 1 fully-covered page
+        pool.release(a)                        # -> retained, refcount 0
+        b = pool.alloc(list(range(100, 104)), 4)   # drains the free list
+        assert b is not None
+        stats0 = pool.stats()
+        # shared hit on the retained page + 1 fresh page nothing can
+        # supply: admission control (None), NOT a duplicated lease
+        c = pool.alloc(list(range(4)), 8)
+        assert c is None
+        assert pool.stats() == stats0          # rollback re-parked it
+        pool.release(b)                        # room opens up
+        d = pool.alloc(list(range(4)), 8)
+        assert d is not None
+        pages = list(map(int, d.pages[:d.n_pages]))
+        assert len(set(pages)) == len(pages)   # all distinct
+        assert d.shared and 0 not in pages
 
     def test_config_validation_and_floor(self):
         with pytest.raises(ValueError, match="kv_dtype"):
@@ -555,6 +584,75 @@ class TestRouterUnits:
         with pytest.raises(ValueError, match="unique"):
             Router([_StubReplica("a"), _StubReplica("a")])
 
+    def test_slow_replica_times_out_without_resubmit(self):
+        """A healthy-but-slow replica raises TimeoutError from result():
+        the router must surface it, not declare the replica dead and
+        stack a duplicate in-flight copy of the request on it."""
+        class _SlowPending:
+            def result(self, timeout=None):
+                raise TimeoutError("still pending")
+
+        class _SlowReplica(_StubReplica):
+            def submit(self, tokens, **kw):
+                self.submits.append(kw)
+                return _SlowPending()
+
+        a = _SlowReplica("a")
+        req = Router([a]).submit(np.ones(4, np.int32))
+        with pytest.raises(TimeoutError):
+            req.result(timeout=0.2)
+        assert req.replica_deaths == 0
+        assert len(a.submits) == 1     # exactly one in-flight copy
+
+    def test_replica_death_loop_respects_deadline(self):
+        """Every dispatch dies instantly while the replica still reports
+        healthy (the pathological spin): the caller's deadline must
+        surface as TimeoutError, never an unbounded resubmit loop."""
+        class _DyingPending:
+            def __init__(self, name):
+                self.name = name
+
+            def result(self, timeout=None):
+                time.sleep(0.001)
+                raise ReplicaDead(f"replica {self.name} died")
+
+        class _DyingReplica(_StubReplica):
+            def submit(self, tokens, **kw):
+                self.submits.append(kw)
+                return _DyingPending(self.name)
+
+        router = Router([_DyingReplica("a"), _DyingReplica("b")])
+        req = router.submit(np.ones(4, np.int32))
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError, match="replica deaths"):
+            req.result(timeout=0.2)
+        assert time.perf_counter() - t0 < 5.0
+        assert req.replica_deaths >= 1
+
+    def test_http_pending_timeout_is_not_a_death(self, monkeypatch):
+        """Socket timeouts (bare or URLError-wrapped) surface as
+        TimeoutError and leave the replica healthy; a refused connection
+        is ReplicaDead and marks it down."""
+        import urllib.request as _ur
+
+        replica = HttpReplica("h", port=1)
+
+        for exc in (socket.timeout("timed out"),
+                    urllib.error.URLError(socket.timeout("timed out"))):
+            def _raise(*a, _exc=exc, **kw):
+                raise _exc
+            monkeypatch.setattr(_ur, "urlopen", _raise)
+            with pytest.raises(TimeoutError):
+                replica.submit(np.ones(3, np.int32)).result(timeout=0.1)
+            assert replica.healthy()   # slow is not dead
+
+        def _refuse(*a, **kw):
+            raise ConnectionRefusedError("refused")
+        monkeypatch.setattr(_ur, "urlopen", _refuse)
+        with pytest.raises(ReplicaDead):
+            replica.submit(np.ones(3, np.int32)).result(timeout=0.1)
+        assert not replica.healthy()
+
 
 # ---------------------------------------------------------------------------
 # Scheduler kill: nothing hangs
@@ -580,6 +678,84 @@ class TestSchedulerKill:
         # the queue refuses new work after the death
         with pytest.raises(RuntimeError):
             q.submit(np.ones(4, np.int32))
+
+    def test_kill_mid_step_resolves_each_request_exactly_once(
+            self, monkeypatch):
+        """kill() runs on the CALLER's thread while the worker is inside
+        step(): it must wait for the step boundary — no 'dict changed
+        size' crash iterating running/pending, and no request resolved
+        twice (set_result by the completing step AND set_error by the
+        kill). A stub engine with a slow decode step widens the race
+        window; the scheduler lock is what keeps this green."""
+        cfg = paged_cfg()
+
+        class _StubEngine:
+            config = cfg
+            _control = {"tok": np.zeros(cfg.rows, np.int32)}
+
+            def set_page_row(self, slot, row):
+                pass
+
+            def admit(self, slot, tokens, want, temperature, top_p, seed):
+                return cfg.buckets[-1]
+
+            def decode_step(self):
+                time.sleep(0.002)
+
+            def fetch_slot(self, slot):
+                return (np.zeros(cfg.max_new_tokens, np.int32),
+                        np.zeros(VOCAB, np.float32))
+
+        resolutions = collections.Counter()
+        count_lock = threading.Lock()
+        orig_result = batching.Request.set_result
+        orig_error = batching.Request.set_error
+
+        def counting_result(self, res):
+            with count_lock:
+                resolutions[self.id] += 1
+            orig_result(self, res)
+
+        def counting_error(self, err):
+            with count_lock:
+                resolutions[self.id] += 1
+            orig_error(self, err)
+
+        monkeypatch.setattr(batching.Request, "set_result",
+                            counting_result)
+        monkeypatch.setattr(batching.Request, "set_error", counting_error)
+
+        q = RequestQueue(cfg.buckets)
+        sched = ContinuousScheduler(_StubEngine(), q)
+        stop = threading.Event()
+        worker_err: list = []
+
+        def run():
+            try:
+                sched.run(stop)
+            except BaseException as e:  # noqa: BLE001 - the race crash
+                worker_err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        reqs = [q.submit(s) for s in prompts([4] * 30, seed=23)]
+        time.sleep(0.02)               # land the kill with work in flight
+        sched.kill()
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert not worker_err, f"worker crashed: {worker_err}"
+        served = failed = 0            # everything resolves, nothing hangs
+        for r in reqs:
+            try:
+                r.result(timeout=5.0)
+                served += 1
+            except RuntimeError:       # the kill's error (ReplicaDead kin)
+                failed += 1
+        assert served + failed == len(reqs) and failed > 0
+        assert len(resolutions) == len(reqs)
+        assert set(resolutions.values()) == {1}, (
+            f"double-resolved requests: "
+            f"{[i for i, n in resolutions.items() if n > 1]}")
 
 
 # ---------------------------------------------------------------------------
