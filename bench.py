@@ -379,7 +379,10 @@ def probe_backend(timeout_s: float):
     with _PROBE_LOCK:
         if _RELAY_DEAD.is_set():
             return False, "relay tunnel died (deathwatch firing)", False
-        proc = subprocess.Popen(
+        # spawn+register must be atomic vs the deathwatch sweep (that is
+        # the lock's whole job); the slow part — communicate() — waits
+        # outside the lock below
+        proc = subprocess.Popen(  # analysis: disable=no-blocking-under-lock
             [sys.executable, "-c", _PROBE_SRC],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
         _LIVE_PROBES.add(proc)

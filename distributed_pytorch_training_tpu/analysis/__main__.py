@@ -9,6 +9,9 @@ Flags:
   --rules a,b        run only the named rules (see --list)
   --ast-only         skip the HLO matrix (no jax / device init — fast lint)
   --contracts a,b    evaluate only the named contracts from the matrix
+  --changed          AST rules on git-changed files only (fast local loop);
+                     whole-repo rules (the lock-order graph) and the HLO
+                     matrix are unaffected — they are global by nature
   --list             print the rule catalog (name, kind, rationale) and exit
 
 Exit codes: 0 clean, 1 findings, 2 usage error.
@@ -20,7 +23,43 @@ import argparse
 import json
 import os
 import sys
+from pathlib import Path
 from typing import List, Optional
+
+# --json report layout version. 1 was the implicit, unversioned layout;
+# 2 added this field (consumers should treat a missing field as 1).
+REPORT_SCHEMA_VERSION = 2
+
+
+def _changed_source_files() -> Optional[List[Path]]:
+    """Git-changed .py files (vs HEAD, plus untracked), intersected with
+    the linted set. None when git is unavailable — the caller falls back
+    to the full set: an incremental mode must never lint LESS than a
+    broken git invocation would excuse."""
+    import subprocess
+
+    from .ast_rules import REPO_ROOT, iter_source_files
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            check=True, timeout=30).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO_ROOT, capture_output=True, text=True,
+            check=True, timeout=30).stdout
+    except Exception:  # noqa: BLE001 - not a repo / no git binary
+        return None
+    names = {ln.strip() for ln in (diff + "\n" + untracked).splitlines()
+             if ln.strip().endswith(".py")}
+    linted = {p.resolve() for p in iter_source_files()}
+    out = []
+    for n in sorted(names):
+        p = (REPO_ROOT / n).resolve()
+        if p in linted and p.exists():
+            out.append(p)
+    return out
 
 
 def _ensure_test_mesh() -> None:
@@ -68,6 +107,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(default: all)")
     p.add_argument("--ast-only", action="store_true",
                    help="skip the HLO config matrix (no jax init)")
+    p.add_argument("--changed", action="store_true",
+                   help="per-file AST rules on git-changed files only; "
+                        "global rules and the HLO matrix still run whole")
     p.add_argument("--list", action="store_true", dest="list_rules",
                    help="print the rule catalog and exit")
     args = p.parse_args(argv)
@@ -89,15 +131,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     ast_rule_names = [r.name for r in rules if r.kind == "ast"]
+    global_rule_names = [r.name for r in rules if r.kind == "ast-global"]
     hlo_rule_names = [r.name for r in rules if r.kind == "hlo"]
 
     findings = []
     contract_status = {}
 
-    if ast_rule_names:
+    if ast_rule_names or global_rule_names:
         from .ast_rules import run_ast_rules
 
-        findings += run_ast_rules(rules=ast_rule_names)
+        changed = _changed_source_files() if args.changed else None
+        if args.changed and changed is not None:
+            # incremental: per-file rules on the changed set only; the
+            # whole-repo rules (lock-order graph) still see every file —
+            # a cycle is a property of the union, not of one diff
+            if ast_rule_names:
+                findings += run_ast_rules(files=changed,
+                                          rules=ast_rule_names)
+            if global_rule_names:
+                findings += run_ast_rules(rules=global_rule_names)
+        else:
+            findings += run_ast_rules(
+                rules=ast_rule_names + global_rule_names)
 
     if hlo_rule_names and not args.ast_only:
         try:
@@ -116,6 +171,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.as_json:
         print(json.dumps({
+            "schema_version": REPORT_SCHEMA_VERSION,
             "ok": not findings,
             "n_findings": len(findings),
             "findings": [f.as_dict() for f in findings],

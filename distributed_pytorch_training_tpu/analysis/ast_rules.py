@@ -781,11 +781,20 @@ def run_ast_rules(files: Optional[Iterable[Path]] = None,
                   repo: Path = REPO_ROOT) -> List[Finding]:
     """Run every (selected) AST rule over `files` (default: the repo set).
     Files that fail to parse produce a finding instead of crashing the
-    run — a syntax error is a finding, not an analyzer failure."""
+    run — a syntax error is a finding, not an analyzer failure.
+
+    Kind "ast" rules see one FileContext at a time; kind "ast-global"
+    rules (the lock-order graph) run ONCE over the whole parsed set —
+    their findings anchor to a file:line, so per-line suppression still
+    applies through that file's context."""
     from .contracts import iter_rules
 
-    selected = iter_rules(kind="ast", names=rules)
+    selected = [r for r in iter_rules(names=rules)
+                if r.kind in ("ast", "ast-global")]
+    per_file = [r for r in selected if r.kind == "ast"]
+    global_rules = [r for r in selected if r.kind == "ast-global"]
     findings: List[Finding] = []
+    contexts: Dict[str, FileContext] = {}
     for path in (files if files is not None else iter_source_files(repo)):
         path = Path(path)
         try:
@@ -795,8 +804,14 @@ def run_ast_rules(files: Optional[Iterable[Path]] = None,
                 "parse-error", f"could not parse: {e}",
                 str(path)))
             continue
-        for r in selected:
+        contexts[ctx.relpath] = ctx
+        for r in per_file:
             for f in r.check(ctx):
                 if not ctx.suppressed(f):
                     findings.append(f)
+    for r in global_rules:
+        for f in r.check(list(contexts.values())):
+            ctx = contexts.get(f.location.rsplit(":", 1)[0])
+            if ctx is None or not ctx.suppressed(f):
+                findings.append(f)
     return findings

@@ -116,7 +116,7 @@ def iter_rules(kind: Optional[str] = None,
     engines here (not at module import) keeps this module dependency-free
     for the AST-only path.
     """
-    from . import ast_rules, hlo_rules  # noqa: F401  (registration side effect)
+    from . import ast_rules, concurrency_rules, hlo_rules  # noqa: F401  (registration side effect)
 
     if names is not None:
         wanted = list(names)

@@ -121,6 +121,11 @@ class ShardedLoader:
     def _python_epoch(self, epoch: int,
                       start_step: int = 0) -> Iterator[Dict[str, jax.Array]]:
         """Pure-Python fallback: background thread + queue prefetch."""
+        # producer/consumer share NO locked state: the queue is its own
+        # synchronization, `stop` is a monotonic Event, and `err` is
+        # published before the sentinel (the q.put/q.get pair is the
+        # happens-before edge the consumer reads err[0] through); the
+        # blocking q.get below runs with no lock held
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         stop = threading.Event()

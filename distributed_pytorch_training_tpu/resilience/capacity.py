@@ -34,6 +34,7 @@ import threading
 from typing import Callable, Optional
 
 from ..telemetry import recorder as _telemetry
+from ..utils.locktrace import named_lock
 
 
 class CapacityWatch:
@@ -52,13 +53,13 @@ class CapacityWatch:
         if total < 1:
             raise ValueError(f"a fleet needs >= 1 replica, got {total}")
         self.total = int(total)
-        self._available = int(total if available is None else available)
+        self._available = int(total if available is None else available)  # guarded-by: _lock
         if not 0 <= self._available <= self.total:
             raise ValueError(
                 f"available ({self._available}) must lie in "
                 f"[0, total={self.total}]")
-        self._probe = probe
-        self._lock = threading.Lock()
+        self._probe = probe   # set once here, immutable after
+        self._lock = named_lock("CapacityWatch._lock")
         # set whenever capacity INCREASES (restore / a probe reading above
         # the last one) — a cheap "worth polling" hint for callers that
         # want to wait instead of poll; cleared by poll_grow
@@ -66,10 +67,16 @@ class CapacityWatch:
 
     def available(self) -> int:
         """Current available replica count (probe-synced when armed)."""
+        # consult the probe OUTSIDE the lock: it is an arbitrary external
+        # callable (a device/cluster feed — possibly a network round
+        # trip, possibly re-entering this registry), and holding the
+        # lock across it would serialize every lose/restore/sync on the
+        # slowest probe — and self-deadlock on a re-entrant one
+        fresh: Optional[int] = None
+        if self._probe is not None:
+            fresh = max(0, min(int(self._probe()), self.total))
         with self._lock:
-            if self._probe is not None:
-                fresh = int(self._probe())
-                fresh = max(0, min(fresh, self.total))
+            if fresh is not None:
                 if fresh > self._available:
                     self.returned.set()
                 self._available = fresh

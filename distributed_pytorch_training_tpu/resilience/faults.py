@@ -70,6 +70,8 @@ import time
 from pathlib import Path
 from typing import Callable, List, Optional, Tuple
 
+from ..utils.locktrace import named_lock
+
 CHAOS_ENV = "DPT_CHAOS"
 
 # kind -> the only trigger it accepts (a typo'd trigger must fail loudly).
@@ -226,15 +228,15 @@ class FaultInjector:
         # [fault, remaining firings] — `remaining` starts at the parsed
         # repeat count (1 without an xK suffix) and the fault leaves the
         # pending list only once spent
-        self._pending: List[list] = [[f, f.count] for f in plan.faults]
-        self.fired: List[str] = []
-        self.saves_seen = 0
-        self.finalizes_seen = 0
+        self._pending: List[list] = [[f, f.count] for f in plan.faults]  # guarded-by: _lock
+        self.fired: List[str] = []   # guarded-by: _lock
+        self.saves_seen = 0          # guarded-by: _lock
+        self.finalizes_seen = 0      # guarded-by: _lock
         # the hooks fire from different threads (the step fence on the
         # main thread, on_loader_batch from the loader's producer thread)
         # and an unsynchronized take could skip a matching fault — the
         # schedule must stay deterministic under prefetch
-        self._lock = threading.Lock()
+        self._lock = named_lock("FaultInjector._lock")
 
     def unfired(self) -> List[str]:
         with self._lock:

@@ -115,6 +115,9 @@ def try_clean_pjrt_close(timeout_s: float = 5.0,
         finally:
             done.set()
 
+    # daemon + deliberately never joined (thread-lifecycle: daemon=True is
+    # the sanctioned shape): a wedged PJRT close can block FOREVER on the
+    # dead relay port, and the whole point is to abandon it and abort
     t = threading.Thread(target=close, daemon=True, name="pjrt-close")
     t.start()
     if not done.wait(timeout_s):

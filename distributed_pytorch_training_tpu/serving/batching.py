@@ -38,6 +38,7 @@ import numpy as np
 
 from .. import telemetry
 from ..data.pack import bucket_for
+from ..utils.locktrace import named_condition, named_lock
 
 
 @dataclasses.dataclass
@@ -57,8 +58,8 @@ class Request:
     """One submitted prompt; waitable. ``result()`` blocks until the engine
     (or a drain-time rejection) resolves it."""
 
-    _ids = iter(range(1, 1 << 62))
-    _ids_lock = threading.Lock()
+    _ids = iter(range(1, 1 << 62))   # guarded-by: _ids_lock
+    _ids_lock = named_lock("Request._ids_lock")
 
     def __init__(self, tokens: np.ndarray,
                  return_prompt_logits: bool = False,
@@ -89,6 +90,9 @@ class Request:
         self.t_submit = time.perf_counter()
         self.t_done: Optional[float] = None  # set at resolution (bench read)
         self.t_first_token: Optional[float] = None  # TTFT (prefill emits #0)
+        # _result/_error are Event-synchronized, not locked: exactly one
+        # resolver writes them, then _done.set() publishes (the Event's
+        # internal lock is the happens-before edge result() reads through)
         self._done = threading.Event()
         self._result: Optional[Result] = None
         self._error: Optional[BaseException] = None
@@ -119,9 +123,9 @@ class RequestQueue:
         if not buckets:
             raise ValueError("the bucket ladder must have at least one rung")
         self.buckets = tuple(sorted(int(b) for b in buckets))
-        self._q: Deque[Request] = collections.deque()
-        self._cv = threading.Condition()
-        self._closed = False
+        self._q: Deque[Request] = collections.deque()   # guarded-by: _cv
+        self._cv = named_condition("RequestQueue._cv")
+        self._closed = False                            # guarded-by: _cv
 
     def __len__(self) -> int:
         with self._cv:

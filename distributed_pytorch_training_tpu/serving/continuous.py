@@ -67,6 +67,7 @@ from ..models.layers import (
 )
 from ..parallel.mesh import batch_shard_count
 from ..parallel.sharding import batch_sharding, replicated
+from ..utils.locktrace import named_lock
 from .batching import Request, RequestQueue, Result
 from .engine import InferenceEngine
 from .paged import PagedServeConfig, PageLease, PagePool
@@ -455,25 +456,25 @@ class ContinuousScheduler:
         self.pool = PagePool(cfg.total_pages, cfg.page_size,
                              cfg.pages_per_slot,
                              prefix_sharing=cfg.prefix_sharing)
-        self.free_slots: List[int] = list(range(cfg.rows))
-        self.running: Dict[int, _SlotState] = {}
-        self.pending: List[Request] = []
-        self._t_popped: Dict[int, float] = {}
-        self.served = 0
-        self.killed = False
+        self.free_slots: List[int] = list(range(cfg.rows))  # guarded-by: _lock
+        self.running: Dict[int, _SlotState] = {}            # guarded-by: _lock
+        self.pending: List[Request] = []                    # guarded-by: _lock
+        self._t_popped: Dict[int, float] = {}               # guarded-by: _lock
+        self.served = 0                                     # guarded-by: _lock
+        self.killed = False                                 # guarded-by: _lock
         # serializes step() against kill(): kill runs on the CALLER's
         # thread (InProcessReplica.kill) while the worker is mid-step,
         # and without the lock it races the running/pending iteration
         # (dict changed size) and can double-resolve a request that is
         # completing at the instant of death
-        self._lock = threading.Lock()
+        self._lock = named_lock("ContinuousScheduler._lock")
         # max decode steps per fence when nothing is waiting to join
         # (see step()); 1 restores strict fence-per-token behavior
         self.burst_steps = 4
 
     # -- admission -----------------------------------------------------------
 
-    def _gauges(self) -> None:
+    def _gauges(self) -> None:   # lock-held: _lock
         cfg: PagedServeConfig = self.engine.config
         telemetry.gauge("serving_slot_occupancy",
                         len(self.running) / max(cfg.rows, 1))
@@ -484,7 +485,7 @@ class ContinuousScheduler:
                         len(self.queue) + len(self.pending)
                         + len(self.running))
 
-    def _try_admit(self, req: Request) -> bool:
+    def _try_admit(self, req: Request) -> bool:   # lock-held: _lock
         """One admission attempt: needs a free slot AND a page lease.
         False means 'not now' (the request stays pending) — admission
         pressure is absorbed here, never by a recompile."""
@@ -516,14 +517,14 @@ class ContinuousScheduler:
         self._gauges()
         return True
 
-    def _admit_pending(self) -> None:
+    def _admit_pending(self) -> None:   # lock-held: _lock
         still: List[Request] = []
         for req in self.pending:
             if not self._try_admit(req):
                 still.append(req)
         self.pending = still
 
-    def _pull(self, timeout: float = 0.005) -> None:
+    def _pull(self, timeout: float = 0.005) -> None:   # lock-held: _lock
         # keep at most ~2 pool-fulls on deck; never block while slots are
         # actively decoding (the queue wait is for the idle loop only)
         cap = 2 * self.engine.config.rows - len(self.pending)
@@ -538,7 +539,7 @@ class ContinuousScheduler:
 
     # -- the decode hot loop -------------------------------------------------
 
-    def _step_decode_loop(self, n_steps: int) -> None:
+    def _step_decode_loop(self, n_steps: int) -> None:   # lock-held: _lock
         """``n_steps`` compiled decode steps, mirrors replayed in Python —
         NO host fetch in here (the ``no-host-sync-in-decode`` lint pins
         this function by name). Completion fetches happen afterwards, in
@@ -549,7 +550,7 @@ class ContinuousScheduler:
                 if st.left > 0:
                     st.left -= 1
 
-    def _complete_finished(self) -> None:
+    def _complete_finished(self) -> None:   # lock-held: _lock
         t0 = time.perf_counter()
         done = [slot for slot, st in self.running.items() if st.left == 0]
         for slot in done:
@@ -626,25 +627,31 @@ class ContinuousScheduler:
         """Serve until ``stop`` is set AND everything accepted has
         completed (stop = drain, the SIGTERM contract). Returns requests
         served."""
-        while not self.killed:
+        # unlocked reads of killed/running/pending/served below are the
+        # worker's OWN loop control + post-mortem logging: killed is a
+        # monotonic flag step() re-checks under the lock before touching
+        # anything, and after kill() the collections are already cleared
+        while not self.killed:  # analysis: disable=guarded-by
             if stop.is_set():
                 self.queue.close()
             busy = self.step()
             if stop.is_set() and not busy and not len(self.queue):
                 break
-        if self.killed and log is not None:
+        if self.killed and log is not None:  # analysis: disable=guarded-by
             log("serving: scheduler killed with "
-                f"{len(self.running) + len(self.pending)} in flight")
-        return self.served
+                f"{len(self.running) + len(self.pending)} in flight")  # analysis: disable=guarded-by
+        return self.served  # analysis: disable=guarded-by
 
     def drain(self, log=None) -> int:
         """Finish everything queued + in flight, then return — wrapped in
         the ``drain`` span like the iteration-granular path."""
         stop = threading.Event()
         stop.set()
+        # span attrs are a racy diagnostic snapshot, deliberately taken
+        # without stalling the worker's step for it
         with telemetry.span("drain",
-                            pending=len(self.queue) + len(self.pending),
-                            running=len(self.running)):
+                            pending=len(self.queue) + len(self.pending),  # analysis: disable=guarded-by
+                            running=len(self.running)):  # analysis: disable=guarded-by
             return self.run(stop, log=log)
 
     def kill(self, err: Optional[BaseException] = None) -> List[Request]:

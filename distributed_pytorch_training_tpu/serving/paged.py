@@ -37,12 +37,12 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..data.pack import prompt_page_hashes
+from ..utils.locktrace import named_lock
 from .engine import ServeConfig
 
 KV_DTYPES = ("fp32", "int8")
@@ -115,20 +115,20 @@ class PagePool:
         self.page_size = int(page_size)
         self.pages_per_slot = int(pages_per_slot)
         self.prefix_sharing = bool(prefix_sharing)
-        self._lock = threading.Lock()
-        self._free: List[int] = list(range(1, self.n_pages))
-        self._ref: Dict[int, int] = {}
-        self._by_hash: Dict[str, int] = {}
-        self._hash_of: Dict[int, str] = {}
+        self._lock = named_lock("PagePool._lock")
+        self._free: List[int] = list(range(1, self.n_pages))   # guarded-by: _lock
+        self._ref: Dict[int, int] = {}                         # guarded-by: _lock
+        self._by_hash: Dict[str, int] = {}                     # guarded-by: _lock
+        self._hash_of: Dict[int, str] = {}                     # guarded-by: _lock
         # refcount-0 prefix pages, oldest first — the eviction queue
         self._retained: "collections.OrderedDict[int, None]" = \
-            collections.OrderedDict()
-        self.evictions = 0
-        self.prefix_hits = 0
+            collections.OrderedDict()                          # guarded-by: _lock
+        self.evictions = 0                                     # guarded-by: _lock
+        self.prefix_hits = 0                                   # guarded-by: _lock
 
     # -- internals (lock held) ----------------------------------------------
 
-    def _take_page(self) -> Optional[int]:
+    def _take_page(self) -> Optional[int]:   # lock-held: _lock
         if self._free:
             return self._free.pop()
         if self._retained:  # evict the LRU retained prefix page
@@ -140,7 +140,7 @@ class PagePool:
             return page
         return None
 
-    def _release_page(self, page: int) -> None:
+    def _release_page(self, page: int) -> None:   # lock-held: _lock
         self._ref[page] -= 1
         if self._ref[page] > 0:
             return

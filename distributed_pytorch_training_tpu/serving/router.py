@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import telemetry
+from ..utils.locktrace import named_lock
 from .batching import Request, RequestQueue, Result
 
 _GAUGE_RE = re.compile(
@@ -85,6 +86,8 @@ class InProcessReplica:
         return self._thread.is_alive() and not self.scheduler.killed
 
     def queue_depth(self) -> int:
+        # racy snapshot of another thread's collections, by design: the
+        # router wants a cheap load estimate, not a fenced truth
         return (len(self.queue) + len(self.scheduler.pending)
                 + len(self.scheduler.running))
 
@@ -121,6 +124,9 @@ class HttpReplica:
         self.port = int(port)
         self.metrics_port = metrics_port
         self.timeout_s = float(timeout_s)
+        # deliberately unguarded: a monotonic-ish health HINT written by
+        # whichever request finished last — a stale read only delays the
+        # router's next probe, it cannot corrupt anything
         self._last_ok = True
 
     def _url(self, path: str, port: int) -> str:
@@ -200,8 +206,8 @@ class RouterRequest:
     RESUBMITTED to survivors if that replica dies before completing.
     ``replica_deaths`` counts the retries the caller never saw."""
 
-    _seeds = iter(range(1, 1 << 62))
-    _seeds_lock = threading.Lock()
+    _seeds = iter(range(1, 1 << 62))   # guarded-by: _seeds_lock
+    _seeds_lock = named_lock("RouterRequest._seeds_lock")
 
     def __init__(self, router: "Router", tokens: np.ndarray, kw: dict):
         self.router = router
@@ -279,8 +285,8 @@ class Router:
         if len(set(names)) != len(names):
             raise ValueError(f"replica names must be unique, got {names}")
         self.replicas: Dict[str, object] = {r.name: r for r in replicas}
-        self._rr = 0
-        self._lock = threading.Lock()
+        self._rr = 0   # guarded-by: _lock
+        self._lock = named_lock("Router._lock")
 
     def _pick(self, exclude: Sequence[str] = ()):
         # snapshot under the lock, PROBE outside it: healthy() and

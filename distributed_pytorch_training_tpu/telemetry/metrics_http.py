@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..utils.locktrace import named_lock
 from .device import DEVICE_PROFILE_KIND, split_of_event
 from .recorder import (
     ELASTIC_SPAN_NAMES,
@@ -96,26 +97,26 @@ class _MetricsState:
     produced it (``dpt_build_info`` + the /healthz body fields)."""
 
     def __init__(self, identity: Optional[Dict[str, Any]] = None):
-        self._lock = threading.Lock()
+        self._lock = named_lock("_MetricsState._lock")
         self._t0 = time.monotonic()
         self.identity = {"gen": 0, "rank": 0,
                          "schema_version": SCHEMA_VERSION, "backend": "",
                          **(identity or {})}
-        self.events_total = 0
-        self.steps_total = 0
-        self.last_step = -1
-        self.epoch = -1
-        self.last_progress = self._t0
+        self.events_total = 0        # guarded-by: _lock
+        self.steps_total = 0         # guarded-by: _lock
+        self.last_step = -1          # guarded-by: _lock
+        self.epoch = -1              # guarded-by: _lock
+        self.last_progress = self._t0   # guarded-by: _lock
         # phase -> (bucket counts, sum_s, count)
-        self.phases: Dict[str, Tuple[List[int], float, int]] = {}
-        self.wire: Dict[Tuple[str, str, str], float] = {}
-        self.anomalies: Dict[str, int] = {}
-        self.gauges: Dict[str, float] = {}
+        self.phases: Dict[str, Tuple[List[int], float, int]] = {}  # guarded-by: _lock
+        self.wire: Dict[Tuple[str, str, str], float] = {}          # guarded-by: _lock
+        self.anomalies: Dict[str, int] = {}                        # guarded-by: _lock
+        self.gauges: Dict[str, float] = {}                         # guarded-by: _lock
         # device-time attribution (ISSUE 15): per-phase device seconds +
         # the latest exposed-comm ratio, fed by device_profile events
-        self.device_seconds: Dict[str, float] = {}
-        self.device_profiles = 0
-        self.exposed_comm_ratio: Optional[float] = None
+        self.device_seconds: Dict[str, float] = {}                 # guarded-by: _lock
+        self.device_profiles = 0                                   # guarded-by: _lock
+        self.exposed_comm_ratio: Optional[float] = None            # guarded-by: _lock
 
     # -- the observer ---------------------------------------------------
 
@@ -552,9 +553,10 @@ class FederationServer:
             else (lambda t=list(targets): t)
         self.timeout_s = float(timeout_s)
         self.refresh_s = refresh_s
-        self._lock = threading.Lock()
-        # identity -> {"body": str, "up": bool, "port": int}
-        self._cache: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._lock = named_lock("FederationServer._lock")
+        # identity -> {"body": str, "up": bool, "port": int}; scrapes
+        # happen OUTSIDE the lock (refresh), only the cache swap is under
+        self._cache: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded-by: _lock
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
         self._refresher: Optional[threading.Thread] = None

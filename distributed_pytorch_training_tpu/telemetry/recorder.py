@@ -54,6 +54,8 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Deque, Dict, List, Optional
 
+from ..utils.locktrace import named_lock
+
 # v2 (ISSUE 14): every event (meta included) carries `gen`/`rank`. Readers
 # accept v1 streams — a missing gen/rank reads as 0/0 (the aggregator's
 # normalization), and `summarize` never keyed on the version.
@@ -185,7 +187,7 @@ class Recorder:
                  meta: Optional[Dict[str, Any]] = None,
                  gen: Optional[int] = None, rank: Optional[int] = None):
         self.path = Path(path) if path is not None else None
-        self.ring: Deque[dict] = collections.deque(maxlen=max(1, ring_size))
+        self.ring: Deque[dict] = collections.deque(maxlen=max(1, ring_size))  # guarded-by: _lock
         self.run_id = run_id or f"run-{os.getpid()}-{int(time.time())}"
         # stream identity (v2): env stamps win, explicit args override —
         # stamped on EVERY event so merged/append-shared files stay
@@ -193,15 +195,15 @@ class Recorder:
         self.gen = int(gen) if gen is not None else generation_identity()
         self.rank = int(rank) if rank is not None else rank_identity()
         self._fsync_every_s = fsync_every_s
-        self._last_fsync = time.monotonic()
-        self._lock = threading.Lock()
-        self._fh = None
+        self._last_fsync = time.monotonic()   # guarded-by: _lock
+        self._lock = named_lock("Recorder._lock")
+        self._fh = None                       # guarded-by: _lock
         # observers (telemetry/metrics_http.py): called with each event
         # AFTER it is recorded, outside the stream lock (an observer
         # taking its own lock must never be able to deadlock an emit).
         # Empty on every run without a live surface — one list check.
-        self._observers: List[Callable[[dict], None]] = []
-        self.n_events = 0
+        self._observers: List[Callable[[dict], None]] = []  # guarded-by: _lock
+        self.n_events = 0                     # guarded-by: _lock
         if self.path is not None:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a", encoding="utf-8")

@@ -180,7 +180,12 @@ class CheckpointManager:
         self._known_bad: dict = {}
         # the one in-flight background write (at most one: the next save
         # joins it first, so orbax manager state is never touched from two
-        # threads at once) and its failure, surfaced at the next barrier
+        # threads at once) and its failure, surfaced at the next barrier.
+        # Lock-free by protocol, not by accident: the writer thread writes
+        # _writer_label/_writer_error, the caller reads them only AFTER
+        # _join_writer's t.join() — the join IS the happens-before edge,
+        # and the at-most-one-writer invariant means there is never a
+        # second thread to race
         self._writer: Optional[threading.Thread] = None
         self._writer_label: Optional[int] = None
         self._writer_error: Optional[BaseException] = None

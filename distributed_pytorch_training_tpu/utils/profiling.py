@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from .locktrace import named_lock
 from .logging import log_main
 
 # ---------------------------------------------------------------------------
@@ -40,7 +41,9 @@ from .logging import log_main
 # a crash mid-training-run.
 # ---------------------------------------------------------------------------
 
-_SESSION_LOCK = threading.Lock()
+_SESSION_LOCK = named_lock("profiling._SESSION_LOCK")
+# read without the lock by session_owner(): a racy diagnostic HINT (the
+# busy-counter label); every decision-making read sits under the lock
 _SESSION_OWNER: Optional[str] = None
 
 
@@ -136,14 +139,18 @@ class StepProfiler:
         self.stop = stop
         self.on_capture = on_capture
         self.max_captures = int(max_captures)
+        # _active/_done/_seen/_window are STEP-THREAD state by design:
+        # only __call__/close (the trainer's hook thread) touch them, so
+        # they need no lock — cross-thread traffic comes in through
+        # _pending only
         self._active = False          # the static window's session
         self._done = False            # the static window fired already
         self._seen = 0
-        self._lock = threading.Lock()
-        self._pending: Optional[Dict[str, Any]] = None
+        self._lock = named_lock("StepProfiler._lock")
+        self._pending: Optional[Dict[str, Any]] = None   # guarded-by: _lock
         self._window: Optional[Dict[str, Any]] = None  # armed, in flight
-        self._n_captures = 0
-        self.busy_refused = 0
+        self._n_captures = 0          # guarded-by: _lock
+        self.busy_refused = 0         # guarded-by: _lock
 
     # -- on-demand arming (thread-safe: HTTP/watchdog callers) -----------
 
@@ -180,7 +187,7 @@ class StepProfiler:
                              "trigger_step": trigger_step}
             return True
 
-    def _capture_dir(self) -> str:
+    def _capture_dir(self) -> str:   # lock-held: _lock
         # pid-qualified: fleet children of successive generations share
         # one profiles directory, and trace parsing globs recursively —
         # two captures must never mix sessions under one subdir
@@ -274,7 +281,11 @@ class StepProfiler:
             with self._lock:
                 pending, self._pending = self._pending, None
             if pending is not None:
-                trace_dir = self._capture_dir()
+                # under the lock: _capture_dir draws from the shared
+                # capture budget, and a concurrent capture() drawing at
+                # the same instant would mint the same directory name
+                with self._lock:
+                    trace_dir = self._capture_dir()
                 if _acquire_session("StepProfiler.armed"):
                     jax.profiler.start_trace(trace_dir)
                     self._window = {"dir": trace_dir,

@@ -69,6 +69,11 @@ _FILE_BUDGETS_S = {
     # budget driver, so a new engine config or bucket rung must name
     # itself here.
     "test_continuous.py": 150.0,       # measured ~33 s fast
+    # The concurrency-discipline suite (ISSUE 18): AST lint over tmp
+    # sources + tiny stub engines + deterministic gated interleavings
+    # with sub-second waits — the budget driver is the sum of the small
+    # join timeouts, which accrete per interleaving test.
+    "test_analysis_concurrency.py": 60.0,   # measured ~7 s fast
 }
 _file_seconds: dict = {}
 

@@ -16,6 +16,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     would be vacuously green)."""
     assert main(["check", "--json"]) == 0
     report = json.loads(capsys.readouterr().out)
+    assert report["schema_version"] == 2
     assert report["ok"] is True and report["findings"] == []
     statuses = report["contracts"]
     assert set(statuses) == {"dp", "dp_accum", "zero1", "zero1_bf16",
@@ -49,6 +50,11 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "fsdp-gather-rides-data-only" in kinds
     assert "span-names-registered" in kinds
     assert "profiler-session-via-stepprofiler-only" in kinds
+    # the concurrency discipline pass (ISSUE 18)
+    assert "guarded-by" in kinds
+    assert "lock-order-acyclic" in kinds
+    assert "no-blocking-under-lock" in kinds
+    assert "thread-lifecycle" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):
@@ -107,3 +113,72 @@ def test_findings_drive_nonzero_exit(tmp_path, capsys, monkeypatch):
     report = json.loads(capsys.readouterr().out)
     assert report["ok"] is False
     assert report["findings"][0]["rule"] == "shard-map-shim-only"
+
+
+def test_changed_mode_lints_only_the_git_diff(tmp_path, capsys,
+                                              monkeypatch):
+    """--changed scopes the PER-FILE rules to the git-changed set but
+    keeps whole-repo rules global: a violation in an unchanged file stays
+    invisible to the fast loop, a violation in a changed file flips the
+    exit code."""
+    from distributed_pytorch_training_tpu.analysis import __main__ as cli
+    from distributed_pytorch_training_tpu.analysis import ast_rules
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax.experimental import shard_map\n")
+    monkeypatch.setattr(ast_rules, "iter_source_files",
+                        lambda repo=None: [clean, bad])
+
+    monkeypatch.setattr(cli, "_changed_source_files", lambda: [clean])
+    assert main(["check", "--ast-only", "--changed", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+    monkeypatch.setattr(cli, "_changed_source_files", lambda: [bad])
+    assert main(["check", "--ast-only", "--changed", "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["findings"][0]["rule"] == "shard-map-shim-only"
+
+
+def test_changed_mode_falls_back_to_full_set_without_git(capsys,
+                                                         monkeypatch):
+    """A broken git invocation must widen the lint, never narrow it:
+    _changed_source_files -> None means the full repo runs."""
+    import subprocess
+
+    from distributed_pytorch_training_tpu.analysis import __main__ as cli
+
+    def _no_git(*a, **kw):
+        raise FileNotFoundError("git")
+
+    monkeypatch.setattr(subprocess, "run", _no_git)
+    assert cli._changed_source_files() is None
+    assert main(["check", "--ast-only", "--changed"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_changed_source_files_intersects_the_linted_set(monkeypatch):
+    """Paths git reports that are OUTSIDE the linted tree (deleted
+    files, tests, tooling) must not reach the AST engine."""
+    import subprocess
+
+    from distributed_pytorch_training_tpu.analysis import __main__ as cli
+    from distributed_pytorch_training_tpu.analysis.ast_rules import (
+        REPO_ROOT, iter_source_files,
+    )
+
+    real = sorted(iter_source_files())[0].relative_to(REPO_ROOT)
+
+    class _Out:
+        def __init__(self, stdout):
+            self.stdout = stdout
+
+    def _git(cmd, **kw):
+        if "diff" in cmd:
+            return _Out(f"{real}\nno/such/file.py\nnot_python.txt\n")
+        return _Out("")
+
+    monkeypatch.setattr(subprocess, "run", _git)
+    changed = cli._changed_source_files()
+    assert changed == [(REPO_ROOT / real).resolve()]

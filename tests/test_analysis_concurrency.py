@@ -1,0 +1,953 @@
+"""Concurrency discipline (ISSUE 18): the four static rules
+(analysis/concurrency_rules.py) each get a mutation test (synthetic
+violation flagged) and a false-positive test (idiomatic code stays
+clean); the runtime tracer (utils/locktrace.py) gets zero-cost-when-off
+pins and an on-mode recording suite; and the three PR-17 race fixes get
+deterministic regression tests that a revert trips — through a rule, the
+tracer cross-check, or the interleaving itself.
+"""
+
+import socket
+import textwrap
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_training_tpu.analysis.ast_rules import run_ast_rules
+from distributed_pytorch_training_tpu.analysis.concurrency_rules import (
+    check_runtime_consistency, lock_order_graph,
+)
+from distributed_pytorch_training_tpu.utils import locktrace
+
+
+def _lint(tmp_path, source, rules, name="mod.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    return run_ast_rules(files=[path], rules=rules)
+
+
+def _rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedBy:
+    GUARDED = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []   # guarded-by: _lock
+    """
+
+    def test_mutation_unlocked_write_flags(self, tmp_path):
+        src = self.GUARDED + """
+            def bad(self):
+                self.items.append(1)
+        """
+        findings = _lint(tmp_path, src, ["guarded-by"])
+        assert _rules_of(findings) == {"guarded-by"}
+        assert "items" in findings[0].message
+
+    def test_mutation_unlocked_read_flags(self, tmp_path):
+        src = self.GUARDED + """
+            def bad(self):
+                return len(self.items)
+        """
+        assert _lint(tmp_path, src, ["guarded-by"])
+
+    def test_locked_access_is_clean(self, tmp_path):
+        src = self.GUARDED + """
+            def ok(self):
+                with self._lock:
+                    self.items.append(1)
+                    return list(self.items)
+        """
+        assert _lint(tmp_path, src, ["guarded-by"]) == []
+
+    def test_lock_held_contract_covers_helpers(self, tmp_path):
+        """A helper documented `# lock-held: _lock` accesses guarded
+        state freely — the caller's `with` is the acquisition site."""
+        src = self.GUARDED + """
+            def _helper(self):   # lock-held: _lock
+                return self.items.pop()
+
+            def ok(self):
+                with self._lock:
+                    return self._helper()
+        """
+        assert _lint(tmp_path, src, ["guarded-by"]) == []
+
+    def test_init_is_exempt(self, tmp_path):
+        """Construction precedes sharing: the __init__ that declares the
+        guard writes the attribute lock-free by definition."""
+        src = """
+            import threading
+
+            class C:
+                def __init__(self, seed):
+                    self._lock = threading.Lock()
+                    self.items = [seed]   # guarded-by: _lock
+                    self.items.append(seed + 1)
+        """
+        assert _lint(tmp_path, src, ["guarded-by"]) == []
+
+    def test_nested_function_resets_held_set(self, tmp_path):
+        """A closure defined under `with self._lock` runs LATER, on an
+        arbitrary thread — lexical position is not lock coverage."""
+        src = self.GUARDED + """
+            def bad(self):
+                with self._lock:
+                    def cb():
+                        return self.items.pop()
+                return cb
+        """
+        assert _lint(tmp_path, src, ["guarded-by"])
+
+    def test_suppression_on_the_line(self, tmp_path):
+        src = self.GUARDED + """
+            def snapshot(self):
+                return len(self.items)  # analysis: disable=guarded-by
+        """
+        assert _lint(tmp_path, src, ["guarded-by"]) == []
+
+    def test_unannotated_attrs_are_ignored(self, tmp_path):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.free = 0
+
+                def f(self):
+                    self.free += 1
+        """
+        assert _lint(tmp_path, src, ["guarded-by"]) == []
+
+    def test_class_attr_guard(self, tmp_path):
+        """Class-level shared state (the Request._ids idiom) is matched
+        through ClassName.attr too."""
+        src = """
+            import threading
+
+            class C:
+                _ids = iter(range(9))   # guarded-by: _ids_lock
+                _ids_lock = threading.Lock()
+
+                def ok(self):
+                    with C._ids_lock:
+                        return next(C._ids)
+
+                def bad(self):
+                    return next(C._ids)
+        """
+        findings = _lint(tmp_path, src, ["guarded-by"])
+        assert len(findings) == 1 and findings[0].location.endswith(":13")
+
+
+# ---------------------------------------------------------------------------
+# lock-order-acyclic
+# ---------------------------------------------------------------------------
+
+
+class TestLockOrderAcyclic:
+    def test_mutation_two_file_cycle_flags(self, tmp_path):
+        """The graph is global: each file's nesting is locally consistent,
+        the cycle only exists over the union."""
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        a.write_text(textwrap.dedent("""
+            import threading
+
+            class A:
+                _lock = threading.Lock()
+
+                def f(self):
+                    with A._lock:
+                        with B._lock:
+                            pass
+        """))
+        b.write_text(textwrap.dedent("""
+            import threading
+
+            class B:
+                _lock = threading.Lock()
+
+                def g(self):
+                    with B._lock:
+                        with A._lock:
+                            pass
+        """))
+        findings = run_ast_rules(files=[a, b], rules=["lock-order-acyclic"])
+        assert _rules_of(findings) == {"lock-order-acyclic"}
+        assert "A._lock" in findings[0].message
+        assert "B._lock" in findings[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = """
+            import threading
+
+            class A:
+                _lock = threading.Lock()
+
+                def f(self):
+                    with A._lock:
+                        with B._lock:
+                            pass
+
+                def g(self):
+                    with A._lock:
+                        with B._lock:
+                            pass
+
+            class B:
+                _lock = threading.Lock()
+        """
+        assert _lint(tmp_path, src, ["lock-order-acyclic"]) == []
+
+    def test_module_level_lock_identity(self, tmp_path):
+        src = """
+            import threading
+
+            _REGISTRY_LOCK = threading.Lock()
+
+            class A:
+                _lock = threading.Lock()
+
+                def f(self):
+                    with _REGISTRY_LOCK:
+                        with A._lock:
+                            pass
+
+                def g(self):
+                    with A._lock:
+                        with _REGISTRY_LOCK:
+                            pass
+        """
+        findings = _lint(tmp_path, src, ["lock-order-acyclic"],
+                         name="locks.py")
+        assert len(findings) == 1
+        assert "locks._REGISTRY_LOCK" in findings[0].message
+
+    def test_suppression_on_the_reported_site(self, tmp_path):
+        src = """
+            import threading
+
+            class A:
+                _lock = threading.Lock()
+
+                def f(self):
+                    with A._lock:
+                        with B._lock:  # analysis: disable=lock-order-acyclic
+                            pass
+
+            class B:
+                _lock = threading.Lock()
+
+                def g(self):
+                    with B._lock:
+                        with A._lock:
+                            pass
+        """
+        # the finding anchors at the first (sorted) cycle site — the
+        # line carrying the disable — so nothing survives
+        assert _lint(tmp_path, src, ["lock-order-acyclic"]) == []
+
+    def test_repo_graph_is_acyclic(self):
+        """The real tree's lexical acquisition graph must stay a DAG —
+        this is the whole-repo half of the tier-1 gate."""
+        edges = lock_order_graph()
+        assert check_runtime_consistency(set(), edges) == []
+
+
+# ---------------------------------------------------------------------------
+# no-blocking-under-lock
+# ---------------------------------------------------------------------------
+
+
+class TestNoBlockingUnderLock:
+    def test_mutation_each_blocking_call_flags(self, tmp_path):
+        for call in ("time.sleep(1)",
+                     "urllib.request.urlopen('http://x')",
+                     "socket.create_connection(('h', 1))",
+                     "subprocess.run(['true'])",
+                     "t.join()",
+                     "fut.result(5.0)",
+                     "self._q.get(timeout=1.0)"):
+            src = f"""
+                import socket
+                import subprocess
+                import threading
+                import time
+                import urllib.request
+
+                LOCK = threading.Lock()
+
+                def f(t, fut, self=None):
+                    with LOCK:
+                        {call}
+            """
+            findings = _lint(tmp_path, src, ["no-blocking-under-lock"])
+            assert findings, f"did not flag under lock: {call}"
+
+    def test_outside_the_with_is_clean(self, tmp_path):
+        src = """
+            import threading
+            import time
+
+            LOCK = threading.Lock()
+
+            def f():
+                with LOCK:
+                    n = 1
+                time.sleep(n)
+        """
+        assert _lint(tmp_path, src, ["no-blocking-under-lock"]) == []
+
+    def test_str_join_is_not_thread_join(self, tmp_path):
+        src = """
+            import threading
+
+            LOCK = threading.Lock()
+
+            def f(parts):
+                with LOCK:
+                    return ", ".join(parts)
+        """
+        assert _lint(tmp_path, src, ["no-blocking-under-lock"]) == []
+
+    def test_condition_wait_on_held_lock_is_exempt(self, tmp_path):
+        """cv.wait RELEASES cv while blocked — the canonical pattern,
+        not a hold-while-blocking bug."""
+        src = """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def take(self, timeout):
+                    with self._cv:
+                        self._cv.wait(timeout)
+        """
+        assert _lint(tmp_path, src, ["no-blocking-under-lock"]) == []
+
+    def test_suppression(self, tmp_path):
+        src = """
+            import threading
+            import time
+
+            LOCK = threading.Lock()
+
+            def f():
+                with LOCK:
+                    time.sleep(0.1)  # analysis: disable=no-blocking-under-lock
+        """
+        assert _lint(tmp_path, src, ["no-blocking-under-lock"]) == []
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestThreadLifecycle:
+    def test_mutation_undaemonized_unjoined_flags(self, tmp_path):
+        src = """
+            import threading
+
+            def f(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+        """
+        findings = _lint(tmp_path, src, ["thread-lifecycle"])
+        assert _rules_of(findings) == {"thread-lifecycle"}
+
+    def test_daemon_kwarg_is_clean(self, tmp_path):
+        src = """
+            import threading
+
+            def f(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+        """
+        assert _lint(tmp_path, src, ["thread-lifecycle"]) == []
+
+    def test_joined_elsewhere_in_file_is_clean(self, tmp_path):
+        """The start/join pair commonly spans methods (start in run(),
+        join in stop()) — the rule matches join sites file-wide."""
+        src = """
+            import threading
+
+            class Server:
+                def start(self, fn):
+                    self._t = threading.Thread(target=fn)
+                    self._t.start()
+
+                def stop(self):
+                    self._t.join(5.0)
+        """
+        assert _lint(tmp_path, src, ["thread-lifecycle"]) == []
+
+    def test_daemon_attr_assignment_is_clean(self, tmp_path):
+        src = """
+            import threading
+
+            def f(fn):
+                t = threading.Thread(target=fn)
+                t.daemon = True
+                t.start()
+        """
+        assert _lint(tmp_path, src, ["thread-lifecycle"]) == []
+
+    def test_suppression(self, tmp_path):
+        src = """
+            import threading
+
+            def f(fn):
+                t = threading.Thread(target=fn)  # analysis: disable=thread-lifecycle
+                t.start()
+        """
+        assert _lint(tmp_path, src, ["thread-lifecycle"]) == []
+
+
+def test_repo_is_clean_under_the_concurrency_rules():
+    """The annotated tree carries zero findings from the four rules —
+    the `analysis check` exit-0 half of the ISSUE 18 acceptance."""
+    findings = run_ast_rules(rules=["guarded-by", "lock-order-acyclic",
+                                    "no-blocking-under-lock",
+                                    "thread-lifecycle"])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# static <-> runtime consistency (the cross-check contract)
+# ---------------------------------------------------------------------------
+
+
+class TestRuntimeConsistency:
+    STATIC = {("A.x", "B.y"): "mod.py:10"}
+
+    def test_matching_order_is_consistent(self):
+        assert check_runtime_consistency({("A.x", "B.y")},
+                                         self.STATIC) == []
+
+    def test_new_acyclic_edge_is_consistent(self):
+        assert check_runtime_consistency({("B.y", "C.z")},
+                                         self.STATIC) == []
+
+    def test_reversed_edge_is_reported_with_the_static_site(self):
+        msgs = check_runtime_consistency({("B.y", "A.x")}, self.STATIC)
+        assert msgs and any("mod.py:10" in m for m in msgs)
+
+    def test_runtime_edge_closing_a_cycle_is_reported(self):
+        msgs = check_runtime_consistency({("B.y", "C.z"), ("C.z", "A.x")},
+                                         self.STATIC)
+        assert any("cycle" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# locktrace: zero cost when off
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def lockcheck_off(monkeypatch):
+    monkeypatch.delenv("DPT_LOCKCHECK", raising=False)
+
+
+@pytest.fixture
+def lockcheck_on(monkeypatch):
+    monkeypatch.setenv("DPT_LOCKCHECK", "1")
+    locktrace.trace().reset()
+    yield
+    locktrace.uninstall_probes()
+    locktrace.trace().reset()
+
+
+class TestLocktraceOff:
+    def test_named_lock_is_a_plain_lock(self, lockcheck_off):
+        lk = locktrace.named_lock("X._lock")
+        assert type(lk) is type(threading.Lock())
+        cv = locktrace.named_condition("X._cv")
+        assert type(cv) is threading.Condition
+
+    def test_no_recording(self, lockcheck_off):
+        locktrace.trace().reset()
+        with locktrace.named_lock("X._lock"):
+            pass
+        assert locktrace.trace().acquisitions == []
+
+    def test_probes_are_a_no_op(self, lockcheck_off):
+        orig = time.sleep
+        locktrace.install_probes()
+        try:
+            assert time.sleep is orig
+        finally:
+            locktrace.uninstall_probes()
+
+    def test_no_extra_threads(self, lockcheck_off):
+        before = threading.active_count()
+        locktrace.named_lock("X._lock")
+        locktrace.named_condition("X._cv")
+        assert threading.active_count() == before
+
+
+class TestLocktraceOn:
+    def test_nested_acquire_records_the_edge(self, lockcheck_on):
+        a = locktrace.named_lock("A._lock")
+        b = locktrace.named_lock("B._lock")
+        assert isinstance(a, locktrace.TracedLock)
+        with a:
+            with b:
+                pass
+        tr = locktrace.trace()
+        assert ("A._lock", "B._lock") in tr.order_edges()
+        assert tr.acquisitions == [("A._lock",), ("A._lock", "B._lock")]
+        assert tr.held_by_current_thread() == ()
+
+    def test_condition_over_traced_lock_round_trips(self, lockcheck_on):
+        cv = locktrace.named_condition("Q._cv")
+        box = []
+
+        def consumer():
+            with cv:
+                while not box:
+                    cv.wait(5.0)
+                box.append("seen")
+
+        t = threading.Thread(target=consumer, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            box.append("item")
+            cv.notify()
+        t.join(timeout=5.0)
+        assert not t.is_alive() and box == ["item", "seen"]
+        assert any(name == "Q._cv" for acq in
+                   locktrace.trace().acquisitions for name in acq)
+
+    def test_probe_records_hold_while_blocking(self, lockcheck_on):
+        locktrace.install_probes()
+        try:
+            with locktrace.named_lock("A._lock"):
+                time.sleep(0.001)
+            time.sleep(0.001)   # no lock held: uninteresting, not recorded
+        finally:
+            locktrace.uninstall_probes()
+        events = locktrace.trace().blocking_events
+        assert events == [("time.sleep", ("A._lock",))]
+
+    def test_uninstall_restores_the_originals(self, lockcheck_on):
+        orig_sleep, orig_conn = time.sleep, socket.create_connection
+        locktrace.install_probes()
+        assert time.sleep is not orig_sleep
+        locktrace.uninstall_probes()
+        assert time.sleep is orig_sleep
+        assert socket.create_connection is orig_conn
+
+    def test_cross_check_flags_a_reversal(self, lockcheck_on):
+        assert locktrace.cross_check({("A.x", "B.y"), ("B.y", "A.x")})
+        assert locktrace.cross_check({("A.x", "B.y")}) == []
+
+
+# ---------------------------------------------------------------------------
+# PR-17 regression: PagePool match-time claim (paged.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPagePoolMatchTimeClaim:
+    def test_matched_prefix_page_cannot_be_evicted_into_the_same_lease(
+            self):
+        """The race fix, replayed deterministically: a dry free list must
+        evict some OTHER retained page for the fresh tail — never the
+        prefix page this same alloc just matched. Reverting the
+        match-time refcount bump re-leases one physical page at two
+        logical offsets and the prefill scatter corrupts the shared
+        prefix."""
+        from distributed_pytorch_training_tpu.serving.paged import PagePool
+
+        pool = PagePool(n_pages=3, page_size=1, pages_per_slot=2)
+        first = pool.alloc([5, 6], 2)       # drains the free list
+        assert first is not None and pool.free_pages() == 0
+        pool.release(first)                 # both pages parked, retained
+        lease = pool.alloc([5, 9], 2)       # prefix [5] matches; tail fresh
+        assert lease is not None
+        pages = [int(p) for p in lease.pages[:lease.n_pages]]
+        assert len(set(pages)) == lease.n_pages, (
+            f"one physical page leased at two offsets: {pages}")
+        assert len(lease.shared) == 1
+        assert lease.shared[0] not in pages[1:], (
+            "the matched prefix page was evicted and re-leased as fresh")
+        assert pool._ref[pages[0]] == 1 and pool._ref[pages[1]] == 1
+
+    def test_failed_alloc_rolls_back_the_match_time_claims(self):
+        """The claim-at-match-time bump must be undone when the tail
+        cannot be covered — otherwise admission-control refusals leak
+        refcounts and the prefix page never parks again."""
+        from distributed_pytorch_training_tpu.serving.paged import PagePool
+
+        pool = PagePool(n_pages=4, page_size=1, pages_per_slot=3)
+        a = pool.alloc([5, 6, 7], 3)        # all three pages leased
+        assert a is not None
+        b = pool.alloc([5, 8, 9], 3)        # matches [5], tail uncoverable
+        assert b is None
+        assert pool._ref[int(a.pages[0])] == 1, (
+            "rolled-back match left a refcount behind")
+
+
+# ---------------------------------------------------------------------------
+# PR-17 regression: router deadline + dead-vs-slow (router.py)
+# ---------------------------------------------------------------------------
+
+
+class _DyingReplica:
+    """A replica whose every pending dies instantly — the resubmit loop's
+    worst case."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def submit(self, tokens, **kw):
+        return self
+
+    def result(self, timeout=None):
+        from distributed_pytorch_training_tpu.serving.router import (
+            ReplicaDead)
+        raise ReplicaDead(f"{self.name} died")
+
+    def healthy(self):
+        return True
+
+    def queue_depth(self):
+        return 0
+
+
+class TestRouterDeadline:
+    def test_spent_deadline_raises_instead_of_resubmitting_forever(self):
+        """The race fix: with every replica dying instantly, result(T)
+        must raise TimeoutError once T is spent — reverting the deadline
+        check spins the resubmit loop unboundedly (this test would hang
+        without the worker-thread guard)."""
+        from distributed_pytorch_training_tpu.serving.router import Router
+
+        router = Router([_DyingReplica("r0"), _DyingReplica("r1")])
+        req = router.submit(np.ones(3, np.int32))
+        outcome = []
+
+        def wait():
+            try:
+                req.result(timeout=0.3)
+                outcome.append("returned")
+            except TimeoutError:
+                outcome.append("timeout")
+            except Exception as e:  # noqa: BLE001 - the regression signal
+                outcome.append(repr(e))
+
+        t = threading.Thread(target=wait, daemon=True)
+        t.start()
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "resubmit loop spun past the deadline"
+        assert outcome == ["timeout"]
+        assert req.replica_deaths >= 1
+
+    def test_http_socket_timeout_is_slow_not_dead(self, monkeypatch):
+        """A slow read surfaces as TimeoutError and leaves the health
+        hint intact — resubmitting would stack a duplicate in-flight
+        copy on a healthy-but-busy replica."""
+        from distributed_pytorch_training_tpu.serving.router import (
+            HttpReplica)
+
+        replica = HttpReplica("r0", port=1)
+
+        def _slow(req, timeout=None):
+            raise socket.timeout("read timed out")
+
+        monkeypatch.setattr(urllib.request, "urlopen", _slow)
+        with pytest.raises(TimeoutError):
+            replica.submit(np.ones(3, np.int32)).result(timeout=0.1)
+        assert replica._last_ok is True and replica.healthy()
+
+    def test_http_refused_connection_is_dead(self, monkeypatch):
+        from distributed_pytorch_training_tpu.serving.router import (
+            HttpReplica, ReplicaDead)
+
+        replica = HttpReplica("r0", port=1)
+
+        def _refuse(req, timeout=None):
+            raise urllib.error.URLError(ConnectionRefusedError("refused"))
+
+        monkeypatch.setattr(urllib.request, "urlopen", _refuse)
+        with pytest.raises(ReplicaDead):
+            replica.submit(np.ones(3, np.int32)).result(timeout=0.1)
+        assert replica._last_ok is False and not replica.healthy()
+
+
+# ---------------------------------------------------------------------------
+# PR-17 regression: kill waits for the step boundary (continuous.py)
+# ---------------------------------------------------------------------------
+
+
+class TestKillStepInterleaving:
+    def test_kill_blocks_until_step_releases_the_lock(self, monkeypatch):
+        """Deterministic two-thread interleaving of the kill/step race:
+        T1 parks inside step() (a gated decode_step) holding the
+        scheduler lock; T2's kill() must BLOCK until the step boundary,
+        and the request that step completes resolves as a RESULT, never
+        double-resolved by the kill. Under DPT_LOCKCHECK=1 the traced
+        acquisition order must agree with the static lock graph — the
+        cross-method nesting (scheduler lock -> queue condition) only the
+        tracer can see."""
+        monkeypatch.setenv("DPT_LOCKCHECK", "1")
+        locktrace.trace().reset()
+
+        from distributed_pytorch_training_tpu.serving.batching import (
+            RequestQueue)
+        from distributed_pytorch_training_tpu.serving.continuous import (
+            ContinuousScheduler)
+        from distributed_pytorch_training_tpu.serving.paged import (
+            PagedServeConfig)
+
+        cfg = PagedServeConfig(buckets=(8,), rows=2, max_new_tokens=3,
+                               page_size=4)
+        in_decode = threading.Event()
+        gate = threading.Event()
+
+        class _GatedEngine:
+            config = cfg
+            _control = {"tok": np.zeros(cfg.rows, np.int32)}
+            decodes = 0
+
+            def set_page_row(self, slot, row):
+                pass
+
+            def admit(self, slot, tokens, want, temperature, top_p, seed):
+                return cfg.buckets[-1]
+
+            def decode_step(self):
+                if self.decodes == 0:
+                    in_decode.set()
+                    assert gate.wait(10.0), "test gate never released"
+                self.decodes += 1
+
+            def fetch_slot(self, slot):
+                return (np.zeros(cfg.max_new_tokens, np.int32),
+                        np.zeros(7, np.float32))
+
+        q = RequestQueue(cfg.buckets)
+        sched = ContinuousScheduler(_GatedEngine(), q)
+        req = q.submit(np.arange(4, dtype=np.int32), temperature=0.0)
+
+        stepper = threading.Thread(target=sched.step, daemon=True)
+        stepper.start()
+        assert in_decode.wait(10.0)         # T1 holds _lock, mid-decode
+
+        killer = threading.Thread(target=sched.kill, daemon=True)
+        killer.start()
+        killer.join(timeout=0.3)
+        assert killer.is_alive(), (
+            "kill() mutated scheduler state MID-STEP — the lock is gone")
+
+        gate.set()                          # step boundary: both finish
+        stepper.join(timeout=10.0)
+        killer.join(timeout=10.0)
+        assert not stepper.is_alive() and not killer.is_alive()
+
+        # the step that was in flight completed its request as a result
+        res = req.result(timeout=5.0)
+        assert res.tokens.shape == (3,)
+        assert sched.served == 1 and sched.killed
+
+        # runtime orders agree with the static graph: the scheduler lock
+        # nests OVER the queue condition (step -> _pull -> take), never
+        # the reverse
+        edges = locktrace.trace().order_edges()
+        assert ("ContinuousScheduler._lock", "RequestQueue._cv") in edges
+        assert ("RequestQueue._cv", "ContinuousScheduler._lock") \
+            not in edges
+        assert locktrace.cross_check() == []
+
+
+# ---------------------------------------------------------------------------
+# triage-fix regressions (the findings the rules surfaced on the tree)
+# ---------------------------------------------------------------------------
+
+
+class TestCapacityProbeOutsideLock:
+    def test_reentrant_probe_does_not_deadlock(self):
+        """The guarded-by/no-blocking triage fix: available() used to
+        call the external probe while holding the watch lock — a probe
+        that re-enters the registry (a cluster feed calling sync) then
+        self-deadlocks on the non-reentrant lock. Run in a worker so a
+        revert fails the assert instead of hanging the suite."""
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch)
+
+        watch = CapacityWatch(total=8, available=5)
+
+        def probe():
+            watch.sync(3)       # re-enters the watch's lock
+            return 2
+
+        watch._probe = probe
+        out = []
+        t = threading.Thread(target=lambda: out.append(watch.available()),
+                             daemon=True)
+        t.start()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "available() deadlocked on its own probe"
+        assert out == [2]
+
+    def test_probe_growth_sets_returned(self):
+        from distributed_pytorch_training_tpu.resilience.capacity import (
+            CapacityWatch)
+
+        watch = CapacityWatch(total=8, available=2, probe=lambda: 6)
+        watch.returned.clear()
+        assert watch.available() == 6
+        assert watch.returned.is_set()
+
+
+class TestProfilerCaptureDirUnderLock:
+    def test_armed_open_path_holds_the_lock_for_capture_dir(
+            self, tmp_path, monkeypatch):
+        """The triage fix: __call__'s armed-open path minted the capture
+        directory WITHOUT the lock while capture() mints it under the
+        lock — two concurrent draws could return the same name and mix
+        sessions. Pin the invariant: _capture_dir always runs with the
+        profiler lock held."""
+        from distributed_pytorch_training_tpu.utils import profiling
+        from distributed_pytorch_training_tpu.utils.profiling import (
+            StepProfiler)
+
+        prof = StepProfiler(str(tmp_path))
+        held_at_call = []
+        orig = StepProfiler._capture_dir
+
+        def recording(self):
+            held_at_call.append(self._lock.locked())
+            return orig(self)
+
+        monkeypatch.setattr(StepProfiler, "_capture_dir", recording)
+        monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                            lambda d: None)
+        monkeypatch.setattr(profiling.jax.profiler, "stop_trace",
+                            lambda: None)
+        assert prof.request_capture(steps=1, reason="test")
+        prof(0)     # opens the armed window: the fixed path
+        prof(1)     # closes it
+        with prof.capture(reason="test2") as d:   # the immediate path
+            assert d is not None
+        assert len(held_at_call) == 2
+        assert all(held_at_call), (
+            f"_capture_dir ran without the lock: {held_at_call}")
+        dirs = {p.name for p in tmp_path.iterdir()}
+        assert len(dirs) == 0 or len(dirs) == len(set(dirs))
+
+
+# ---------------------------------------------------------------------------
+# Recorder observer contract (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderObserverContract:
+    def test_blocking_observer_does_not_hold_the_stream_lock(self):
+        """Observers run OUTSIDE the recorder lock: an observer stuck in
+        its callback must not block concurrent emit() or
+        remove_observer() — reverting the snapshot-then-call structure
+        deadlocks this test's second emit."""
+        from distributed_pytorch_training_tpu import telemetry
+
+        rec = telemetry.Recorder(None, ring_size=8)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def blocker(ev):
+            if ev.get("name") == "blocker":
+                entered.set()
+                assert release.wait(10.0)
+
+        rec.add_observer(blocker)
+        t = threading.Thread(
+            target=lambda: rec.emit("span", "blocker", dur_s=0.0),
+            daemon=True)
+        t.start()
+        assert entered.wait(5.0)
+
+        done = []
+
+        def concurrent():
+            rec.emit("span", "other", dur_s=0.0)   # must not wait on t
+            rec.remove_observer(blocker)
+            done.append(True)
+
+        t2 = threading.Thread(target=concurrent, daemon=True)
+        t2.start()
+        t2.join(timeout=5.0)
+        alive = t2.is_alive()
+        release.set()
+        t.join(timeout=5.0)
+        assert not alive, (
+            "emit/remove_observer blocked behind a stuck observer")
+        # 3 = the init-time `meta` stream header + the two span events
+        assert done == [True] and rec.n_events == 3
+
+    def test_observer_exception_is_contained(self):
+        from distributed_pytorch_training_tpu import telemetry
+
+        rec = telemetry.Recorder(None, ring_size=8)
+        rec.add_observer(lambda ev: (_ for _ in ()).throw(RuntimeError()))
+        ev = rec.emit("span", "x", dur_s=0.0)
+        assert ev["name"] == "x" and rec.n_events == 2
+
+
+# ---------------------------------------------------------------------------
+# PARITY: DPT_LOCKCHECK must not move a single device byte
+# ---------------------------------------------------------------------------
+
+
+class TestLockcheckParity:
+    def test_hlo_is_bit_identical_on_and_off(self, monkeypatch):
+        """The PARITY.md clause: locktrace is host-side only. The lowered
+        HLO of a jitted computation must not depend on DPT_LOCKCHECK in
+        any way."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.tanh(x) @ x.T
+
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        monkeypatch.delenv("DPT_LOCKCHECK", raising=False)
+        off = jax.jit(f).lower(x).as_text()
+        monkeypatch.setenv("DPT_LOCKCHECK", "1")
+        on = jax.jit(f).lower(x).as_text()
+        assert on == off
+
+    def test_recorder_stream_is_identical_modulo_timestamps(
+            self, monkeypatch):
+        from distributed_pytorch_training_tpu import telemetry
+
+        def stream(env):
+            if env:
+                monkeypatch.setenv("DPT_LOCKCHECK", "1")
+            else:
+                monkeypatch.delenv("DPT_LOCKCHECK", raising=False)
+            rec = telemetry.Recorder(None, ring_size=8, run_id="pin",
+                                     gen=0, rank=0)
+            rec.emit("span", "step", dur_s=0.5)
+            rec.emit("gauge", "depth", value=3)
+            return [{k: v for k, v in ev.items() if k != "ts"}
+                    for ev in rec.ring]
+
+        assert stream(False) == stream(True)
