@@ -316,6 +316,23 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              config=dict(serving_paged=True, donate_state=True,
                          paged_kv_dtype="int8"),
              kind="serving_paged"),
+    # The speculative-verify contract (ISSUE 19): the target's K+1-window
+    # verify step — the program that replaces the plain decode step in
+    # every speculative round — must carry no host transfers and must
+    # donate pool + control EXACTLY like the plain step: a verify path
+    # that copies the pool pays the per-token memory tax the paged
+    # contract exists to prevent, multiplied by every round, and the
+    # extra n_emit output must NOT cost the alias table an entry
+    # (spec-verify-donated counts entries against the fp32 pool + control
+    # leaf census). The bitwise stream-parity half is runtime behavior,
+    # pinned by tests/test_speculative.py.
+    Contract("serving_spec",
+             "speculative K+1-window verify: no host transfers, pool + "
+             "control donated in place with the n_emit side output "
+             "costing no alias entry (serving/speculative.py "
+             "lower_spec_verify)",
+             config=dict(serving_spec=True, donate_state=True),
+             kind="serving_spec"),
     # The elastic-reshard contract (ISSUE 11): a state resharded N -> M by
     # resilience.elastic must lower to EXACTLY the HLO census a clean-at-M
     # state lowers to — a reshard that lands a leaf replicated (or in any

@@ -1094,6 +1094,36 @@ def check_paged_pool_donated(a: StepArtifacts) -> List[Finding]:
     return []
 
 
+@rule("spec-verify-donated", "hlo",
+      "the speculative verify step aliases the page pool AND every slot "
+      "control buffer in place",
+      "the K+1-window verify step replaces the plain decode step in every "
+      "speculative round (serving/speculative.py lower_spec_verify) and "
+      "donates pool + control exactly like it — but it also RETURNS an "
+      "extra per-slot n_emit output, and an output-order slip there would "
+      "silently knock donated buffers out of the alias table: every round "
+      "would then copy the pool (pool-sized, fleet-wide — the tax paging "
+      "exists to avoid) while the presence-only donation rule stays "
+      "green. This rule counts the alias table against the FULL donated "
+      "census (``spec_cache_leaves`` = pool leaves + control leaves), so "
+      "the n_emit side output must cost zero entries.")
+def check_spec_verify_donated(a: StepArtifacts) -> List[Finding]:
+    if not a.config.get("serving_spec"):
+        return []
+    expect = int(a.config.get("spec_cache_leaves", 0))
+    m = re.search(r"input_output_alias=\{(.*?\))\s*\}", a.optimized_text,
+                  re.DOTALL)
+    entries = len(_ALIAS_ENTRY_RE.findall(m.group(1))) if m else 0
+    if entries < expect:
+        return [Finding(
+            "spec-verify-donated",
+            f"speculative verify step aliases {entries} of the "
+            f">= {expect} donated buffers (k/v pool + slot control) — "
+            "the un-aliased ones are copied on every verify round",
+            a.name)]
+    return []
+
+
 @rule("elastic-reshard-census", "hlo",
       "a resharded N->M state's train step carries exactly the clean-at-M "
       "collective census",
@@ -1189,7 +1219,8 @@ def check_dp_sync_present(a: StepArtifacts) -> List[Finding]:
             # to relax: an inference forward with an all-reduce would be
             # the bug, not the absence of one
             or a.config.get("serving_decode")
-            or a.config.get("serving_paged")):
+            or a.config.get("serving_paged")
+            or a.config.get("serving_spec")):
         # grad-accum keeps sync inside a scan; count it only on the plain arm
         return []
     census = weight_update_census(a.optimized_text, a.min_elements)
@@ -1323,6 +1354,36 @@ def paged_serving_artifacts(engine, name: str = "serving_paged"
     )
 
 
+def spec_serving_artifacts(engine, name: str = "serving_spec"
+                           ) -> StepArtifacts:
+    """StepArtifacts of a SpeculativeEngine's K+1-window verify step —
+    the speculative sibling of `paged_serving_artifacts`.
+    ``spec_cache_leaves`` is the FULL donated census: the fp32 pool's 2
+    layer-stacked buffers plus every slot-control leaf — the verify step
+    returns an extra (rows,) n_emit output, and `spec-verify-donated`
+    demands that side output cost the alias table nothing."""
+    import jax
+
+    from ..parallel.mesh import batch_shard_count
+
+    lowered = engine.lower_spec_verify()
+    optimized = lowered.compile().as_text()
+    try:
+        preopt = preopt_hlo_text(lowered)
+    except Exception:  # pragma: no cover - backend without HLO dialect
+        preopt = None
+    leaves = 2 + len(engine._control)
+    return StepArtifacts(
+        name=name,
+        optimized_text=optimized,
+        preopt_text=preopt,
+        config={"serving_spec": True, "donate_state": True,
+                "spec_cache_leaves": leaves},
+        n_shards=batch_shard_count(engine.mesh),
+        backend=jax.default_backend(),
+    )
+
+
 def evaluate_serving_contract(contract: Contract,
                               mesh=None) -> StepArtifacts:
     """Lower the tiny serving engine's decode step and snapshot artifacts —
@@ -1396,6 +1457,54 @@ def evaluate_paged_serving_contract(contract: Contract,
         artifacts, config={**artifacts.config, **contract.config,
                            "paged_cache_leaves":
                            artifacts.config["paged_cache_leaves"]},
+        min_elements=contract.min_elements)
+
+
+def evaluate_spec_serving_contract(contract: Contract,
+                                   mesh=None) -> StepArtifacts:
+    """The ``kind="serving_spec"`` evaluator: tiny target + even tinier
+    draft behind the REAL speculative path (serving/speculative.py
+    SpeculativeEngine), lower the K+1-window verify step, snapshot its
+    artifacts. fp32 pool by construction — the engine refuses int8 (the
+    exactness gate), so unlike `serving_paged` there is no int8 arm to
+    pin; the census here is pool + full control."""
+    import jax
+    import numpy as np
+
+    from ..models.gpt2 import GPT2LMHead
+    from ..parallel.mesh import MeshSpec, batch_shard_count, build_mesh
+    from ..serving.paged import PagedServeConfig
+    from ..serving.speculative import SpeculativeEngine
+
+    if mesh is None:
+        mesh = build_mesh(MeshSpec(), devices=jax.devices())
+    n_shards = batch_shard_count(mesh)
+    if n_shards < contract.min_shards:
+        raise ValueError(
+            f"contract {contract.name!r} needs >= {contract.min_shards} "
+            f"batch shards (got {n_shards})")
+    # smallest config that still exercises the full alias table: the
+    # donated census (pool + control leaves) is independent of depth /
+    # width / rows / K, and the verify-window compile is the eval's
+    # wall cost — this runs on every full-matrix pass in tier-1
+    model = GPT2LMHead(vocab_size=64, hidden_dim=16, depth=1, num_heads=2,
+                       max_position=32)
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 8), np.int32),
+                        train=False)["params"]
+    draft = GPT2LMHead(vocab_size=64, hidden_dim=16, depth=1, num_heads=2,
+                       max_position=32)
+    draft_params = draft.init(jax.random.PRNGKey(1),
+                              np.zeros((1, 8), np.int32),
+                              train=False)["params"]
+    cfg = PagedServeConfig(buckets=(8,), rows=2, max_new_tokens=2,
+                           page_size=4)
+    engine = SpeculativeEngine(model, mesh, cfg, params, draft,
+                               draft_params, spec_k=1)
+    artifacts = spec_serving_artifacts(engine, name=contract.name)
+    return dataclasses.replace(
+        artifacts, config={**artifacts.config, **contract.config,
+                           "spec_cache_leaves":
+                           artifacts.config["spec_cache_leaves"]},
         min_elements=contract.min_elements)
 
 
@@ -1474,7 +1583,9 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
     route to `evaluate_serving_contract` (the inference engine's decode
     step instead of a Trainer step); ``kind="serving_paged"`` to
     `evaluate_paged_serving_contract` (the SlotEngine's shared paged
-    decode step); ``kind="elastic"`` to `evaluate_elastic_contract`
+    decode step); ``kind="serving_spec"`` to
+    `evaluate_spec_serving_contract` (the speculative K+1-window verify
+    step); ``kind="elastic"`` to `evaluate_elastic_contract`
     (the resharded-vs-clean census pin).
     """
     import jax
@@ -1486,6 +1597,8 @@ def evaluate_contract(contract: Contract, mesh=None) -> StepArtifacts:
         return evaluate_serving_contract(contract, mesh=mesh)
     if contract.kind == "serving_paged":
         return evaluate_paged_serving_contract(contract, mesh=mesh)
+    if contract.kind == "serving_spec":
+        return evaluate_spec_serving_contract(contract, mesh=mesh)
     if contract.kind == "elastic":
         return evaluate_elastic_contract(contract, mesh=mesh)
     if mesh is None:

@@ -562,7 +562,7 @@ def build_slot_engine(devices: Sequence[jax.Device], model_name: str,
                       buckets: Sequence[int] = (8, 16), rows: int = 8,
                       max_new_tokens: int = 8, kv_dtype: str = "fp32",
                       page_size: int = 8, prefix_sharing: bool = True,
-                      n_pages: int = 0, **kw):
+                      n_pages: int = 0, prefix_skip: bool = True, **kw):
     """(SlotEngine, mesh) — the token-granular sibling of
     `build_serving_engine` (same checkpoint templates, mesh validation and
     sizing; ``**kw`` forwards model_overrides/ckpt_dir/train_config/...).
@@ -576,11 +576,61 @@ def build_slot_engine(devices: Sequence[jax.Device], model_name: str,
     cfg = PagedServeConfig(
         buckets=tuple(buckets), rows=rows, max_new_tokens=max_new_tokens,
         page_size=page_size, kv_dtype=kv_dtype, n_pages=n_pages,
-        prefix_sharing=prefix_sharing)
+        prefix_sharing=prefix_sharing, prefix_skip=prefix_skip)
     return build_serving_engine(
         devices, model_name, buckets=buckets, rows=rows,
         max_new_tokens=max_new_tokens, config=cfg, engine_cls=SlotEngine,
         min_positions=cfg.pages_per_slot * cfg.page_size, **kw)
+
+
+def build_spec_engine(devices: Sequence[jax.Device], model_name: str,
+                      draft_model_name: str,
+                      buckets: Sequence[int] = (8, 16), rows: int = 8,
+                      max_new_tokens: int = 8, page_size: int = 8,
+                      prefix_sharing: bool = True, n_pages: int = 0,
+                      prefix_skip: bool = True, draft_k: int = 4,
+                      draft_overrides: Optional[dict] = None,
+                      seed: int = 0, **kw):
+    """(SpeculativeEngine, mesh) — `build_slot_engine` with a draft LM
+    riding along. The target side goes through the exact
+    `build_serving_engine` path (checkpoint templates, mesh validation,
+    position sizing) via an engine_cls closure that injects the draft;
+    the draft itself is ALWAYS random-init fp32 here (it is a throughput
+    device, not a served artifact — acceptance is exact-match against the
+    target, so draft weights change speed, never the emitted stream).
+
+    The draft model's position table is sized from the DRAFT padded view:
+    speculative.py widens ``max_new_tokens`` by K (the last propose run of
+    a request writes draft k/v past the target frontier), so its
+    pages_per_slot can outgrow the target's.
+    """
+    from ..models import get_model
+    from ..serving.paged import PagedServeConfig
+    from ..serving.speculative import SpeculativeEngine
+
+    cfg = PagedServeConfig(
+        buckets=tuple(buckets), rows=rows, max_new_tokens=max_new_tokens,
+        page_size=page_size, kv_dtype="fp32", n_pages=n_pages,
+        prefix_sharing=prefix_sharing, prefix_skip=prefix_skip)
+    dcfg = dataclasses.replace(
+        cfg, max_new_tokens=max_new_tokens + draft_k, n_pages=0)
+    dkwargs = dict(draft_overrides or {})
+    dkwargs.setdefault("max_position",
+                       max(512, dcfg.pages_per_slot * dcfg.page_size))
+    draft = get_model(draft_model_name, dtype=jnp.float32, **dkwargs)
+    dvars = draft.init(jax.random.PRNGKey(seed + 1),
+                       np.zeros((1, min(cfg.buckets)), np.int32),
+                       train=False)
+
+    class _SpecEngine(SpeculativeEngine):
+        def __init__(self, model, mesh, config, params, **ekw):
+            super().__init__(model, mesh, config, params, draft,
+                             dvars["params"], spec_k=draft_k, **ekw)
+
+    return build_serving_engine(
+        devices, model_name, buckets=buckets, rows=rows,
+        max_new_tokens=max_new_tokens, config=cfg, engine_cls=_SpecEngine,
+        min_positions=cfg.pages_per_slot * cfg.page_size, seed=seed, **kw)
 
 
 def measure_serving(model_name: str = "gpt2_124m", n_requests: int = 24,
@@ -744,6 +794,10 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
                                replicas: int = 1,
                                kill_replica: bool = False,
                                temperature: float = 0.0, top_p: float = 1.0,
+                               draft_model: Optional[str] = None,
+                               draft_k: int = 4,
+                               shared_frac: float = 0.0,
+                               prefix_skip: bool = True,
                                devices: Optional[Sequence[jax.Device]] = None,
                                model_overrides: Optional[dict] = None,
                                ckpt_dir: Optional[str] = None, seed: int = 0,
@@ -768,9 +822,26 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
     number, not prose) and per-request TTFT percentiles (prefill emits
     token #0, so TTFT is an admission-latency instrument the
     iteration-granular engine cannot improve on).
+
+    ``draft_model`` arms speculative decoding (fp32-only): each replica
+    becomes a SpeculativeEngine + SpeculativeScheduler pair, and the row
+    grows ``accept_ratio`` / ``accepted_per_verify`` / ``spec_rounds`` —
+    the emitted streams stay BITWISE what the plain row emits (PARITY.md:
+    acceptance is exact match), so the A/B is pure speed.
+    ``shared_frac`` arms prefix-resident admission: that fraction of
+    requests carry one identical page-aligned prompt, and the row grows
+    ``prefill_skips`` / ``tail_resumes`` plus a warm/cold TTFT split —
+    the zero-prefill admission claim as recorded numbers.
     """
     from ..serving.router import InProcessReplica, Router
 
+    if draft_model is not None and kv_dtype != "fp32":
+        # fail at the bench boundary with the bench's vocabulary, not
+        # three layers down in SpeculativeEngine.__init__
+        raise ValueError(
+            f"--draft needs kv_dtype=fp32 (got {kv_dtype}): the verify "
+            "window's in-view rows are fresh fp32 while the int8 path "
+            "reads dequantized page bytes — the bitwise pin would break")
     devices = list(devices) if devices is not None else jax.devices()
     # Each replica gets its own DISJOINT device slice — the fleet
     # topology (replicas never share chips), and a hard requirement
@@ -782,13 +853,22 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
               if replicas > 1 and per >= 1 else [devices] * replicas)
     engines = []
     for i in range(replicas):
-        engine, _ = build_slot_engine(
-            slices[i], model_name, buckets=buckets, rows=rows,
-            max_new_tokens=max_new_tokens, kv_dtype=kv_dtype,
-            page_size=page_size, model_overrides=model_overrides,
-            ckpt_dir=ckpt_dir, seed=seed, optimizer=optimizer,
-            momentum=momentum, weight_decay=weight_decay,
-            train_config=train_config, mesh_spec=mesh_spec)
+        common = dict(
+            buckets=buckets, rows=rows, max_new_tokens=max_new_tokens,
+            page_size=page_size, prefix_skip=prefix_skip,
+            model_overrides=model_overrides, ckpt_dir=ckpt_dir, seed=seed,
+            optimizer=optimizer, momentum=momentum,
+            weight_decay=weight_decay, train_config=train_config,
+            mesh_spec=mesh_spec)
+        if draft_model is not None:
+            # the draft inherits the target's overrides: a vocab override
+            # must hit BOTH sides (acceptance compares token ids)
+            engine, _ = build_spec_engine(
+                slices[i], model_name, draft_model, draft_k=draft_k,
+                draft_overrides=model_overrides, **common)
+        else:
+            engine, _ = build_slot_engine(
+                slices[i], model_name, kv_dtype=kv_dtype, **common)
         engine.warmup()
         engines.append(engine)
     compiles_warm = [e.compiles for e in engines]
@@ -806,6 +886,28 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
     wants = ([int(rng.randint(1, max_new_tokens + 1))
               for _ in range(n_requests)] if mixed_want
              else [max_new_tokens] * n_requests)
+    # prefix-resident arm: ``shared_frac`` of the requests carry ONE
+    # identical page-aligned prompt. The first such request on a replica
+    # prefills and registers the pages; every later one finds the whole
+    # prefix resident and admits with ZERO prefill dispatch
+    # (``prefill_skips`` is the census, the warm/cold TTFT split below is
+    # the latency receipt). The shared indices are rng-spread over the
+    # schedule so warm requests face the same queue depths cold ones do —
+    # the extra draws come AFTER the lens/prompts/wants stream, so the
+    # A/B against measure_serving stays intact.
+    shared_idx: set = set()
+    if shared_frac > 0:
+        n_shared = int(round(shared_frac * n_requests))
+        top = max(engines[0].config.buckets)
+        shared_len = min(max(page_size, top // page_size * page_size), top)
+        shared_prompt = rng.randint(0, max(vocab, 2),
+                                    shared_len).astype(np.int32)
+        if n_shared >= 1:
+            shared_idx = set(
+                int(j) for j in rng.choice(n_requests, size=n_shared,
+                                           replace=False))
+            for j in shared_idx:
+                prompts[j] = shared_prompt
 
     router = Router([InProcessReplica(f"r{i}", e)
                      for i, e in enumerate(engines)])
@@ -855,6 +957,7 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
                 "p99_ms": round(float(np.percentile(
                     [m for _, m in mine], 99)), 2)} if mine else {}),
         }
+    scheds = [rep.scheduler for rep in router.replicas.values()]
     engine = engines[0]
     row = {
         "mode": "serving_continuous",
@@ -882,6 +985,13 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
         "replicas": replicas,
         "replica_deaths": sum(r.replica_deaths for r in reqs),
         "per_replica": per_replica,
+        # the admission fast-path census: skips dispatched NO prefill,
+        # resumes prefilled only the non-resident tail
+        "prefix_skip": prefix_skip,
+        "prefill_skips": sum(s.prefill_skips for s in scheds),
+        "tail_resumes": sum(s.tail_resumes for s in scheds),
+        "shared_frac": shared_frac,
+        "draft": draft_model,
         # the HBM story: the paged (optionally int8) pool vs what the
         # dense fp32 cache would hold for the same rows at the top rung
         "paged_kv_bytes": engine.paged_bytes(),
@@ -890,13 +1000,61 @@ def measure_serving_continuous(model_name: str = "gpt2_124m",
     }
     row["kv_bytes_ratio"] = round(
         row["dense_kv_bytes"] / max(row["paged_kv_bytes"], 1), 2)
+    if draft_model is not None:
+        rounds = sum(s.spec_rounds for s in scheds)
+        proposed = sum(s.spec_proposed for s in scheds)
+        accepted = sum(s.spec_accepted for s in scheds)
+        row["draft_k"] = draft_k
+        row["spec_rounds"] = rounds
+        # accept_ratio is the draft's hit rate; accepted_per_verify is
+        # the speed-up currency — mean draft tokens banked per target
+        # forward (the bonus token rides on top of it)
+        row["accept_ratio"] = round(accepted / max(proposed, 1), 3)
+        row["accepted_per_verify"] = round(accepted / max(rounds, 1), 2)
+        row["draft_kv_bytes"] = engine.draft_bytes()
+        row["backend"] = jax.default_backend()
+        if row["backend"] != "tpu":
+            # same discipline as device_time_split's backend caveat:
+            # a non-TPU row names its own limits instead of passing as
+            # a chip measurement (experiments/results/README.md)
+            row["caveat"] = (
+                "cpu mesh: draft and verify thunks serialize (no ICI "
+                "overlap), so tok/s understates the speculative win; "
+                "random-init drafts pin accept_ratio near zero — only "
+                "trained draft/target pairs on a chip measure real "
+                "acceptance economics")
+    if shared_idx:
+        # warm = shared-prompt requests AFTER their replica's primer (the
+        # one that paid the prefill and registered the pages); everything
+        # else is the cold arm. Attribution is by final replica, so a
+        # resubmitted primer stays a primer on the survivor.
+        primers, seen = set(), set()
+        for i in sorted(shared_idx):
+            name = reqs[i].replica_name
+            if name not in seen:
+                seen.add(name)
+                primers.add(i)
+        warm = [float(ttft_ms[i]) for i in shared_idx if i not in primers]
+        cold = [float(ttft_ms[i]) for i in range(n_requests)
+                if i not in shared_idx or i in primers]
+        if warm:
+            row["ttft_warm_p50_ms"] = round(
+                float(np.percentile(warm, 50)), 2)
+        if cold:
+            row["ttft_cold_p50_ms"] = round(
+                float(np.percentile(cold, 50)), 2)
     try:
         from ..analysis.hlo_rules import (
             check_artifacts, paged_serving_artifacts,
         )
 
-        artifacts = paged_serving_artifacts(engine, name="bench-paged")
-        findings = check_artifacts(artifacts)
+        findings = check_artifacts(
+            paged_serving_artifacts(engine, name="bench-paged"))
+        if draft_model is not None:
+            from ..analysis.hlo_rules import spec_serving_artifacts
+
+            findings.extend(check_artifacts(
+                spec_serving_artifacts(engine, name="bench-spec")))
         row["contracts"] = {
             "pass": not findings,
             "violations": [f.as_dict() for f in findings]}

@@ -88,12 +88,16 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
           runs over the fresh k/v, so prefill logits ARE the eval
           forward's logits bit-for-bit (PARITY.md "Serving shares
           training numerics").
-        * decode (``cache`` + ``cache_positions`` (B,) int32): one new
-          token per row at that row's own position — per-row cache
-          scatter, per-row position embedding, attention over cache slots
-          ``<= position``. Returns (B, 1, vocab) logits for the NEXT
-          token. Rows at different prompt lengths decode in one batch
-          with no recompile (the positions are traced values).
+        * decode (``cache`` + ``cache_positions`` (B,) int32): S new
+          tokens per row starting at that row's own position — per-row
+          cache scatter, per-row position embedding, attention over cache
+          slots ``<= position + j`` for window row j. Returns
+          (B, S, vocab) logits for the NEXT token at each window offset.
+          S == 1 is the classic decode step; S == K+1 is the speculative
+          verify window (serving/speculative.py), whose row j is bitwise
+          the s=1 step at that position. Rows at different prompt lengths
+          decode in one batch with no recompile (the positions are traced
+          values).
 
         With a cache the return value is ``(logits, new_cache)`` where
         ``new_cache`` matches `init_cache`'s structure.
@@ -126,8 +130,19 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
                 jnp.where(valid[..., None], rows, 0.0), self.tp_axis)
         else:
             x = wte(input_ids)
-        pos_ids = (cache_positions[:, None] if decoding
-                   else jnp.arange(s)[None, :])
+        # Decode position ids: s == 1 is the classic one-token step; s > 1
+        # is the speculative verify window — row j sits at absolute
+        # position cache_positions + j (clipped into the wpe table: the
+        # overflow rows past a slot's page span are write-dropped and
+        # never sampled, they only need to stay finite).
+        if decoding and s == 1:
+            pos_ids = cache_positions[:, None]
+        elif decoding:
+            pos_ids = jnp.minimum(
+                cache_positions[:, None] + jnp.arange(s)[None, :],
+                self.max_position - 1)
+        else:
+            pos_ids = jnp.arange(s)[None, :]
         x = x + nn.Embed(self.max_position, self.hidden_dim, dtype=self.dtype,
                          param_dtype=self.param_dtype,
                          embedding_init=nn.initializers.normal(stddev=0.01),
@@ -141,10 +156,20 @@ class GPT2LMHead(VocabPaddingMixin, nn.Module):
         # row's position (later slots are unwritten or prefill pad — both
         # must stay invisible).
         uses_kernel = self.attention_fn is not dot_product_attention
-        if decoding:
+        if decoding and s == 1:
             t = cache[0][0].shape[1]
             mask = (jnp.arange(t)[None, :]
                     <= cache_positions[:, None])[:, None, None, :]
+        elif decoding:
+            # verify window: row j of the window attends cache slots
+            # <= cache_positions + j — each row's visibility is exactly
+            # the s=1 decode step's at that position, so the masked-out
+            # later window rows (scattered but not yet committed) weigh
+            # exactly 0.0 in its softmax (the bitwise argument).
+            t = cache[0][0].shape[1]
+            win = cache_positions[:, None] + jnp.arange(s)[None, :]
+            mask = (jnp.arange(t)[None, None, :]
+                    <= win[:, :, None])[:, None, :, :]
         elif uses_kernel:
             mask = (attention_mask[:, None, None, :].astype(bool)
                     if attention_mask is not None else None)

@@ -91,15 +91,15 @@ def dot_product_attention(
 
 
 def decode_dot_product_attention(
-    q: jnp.ndarray,  # (B, 1, H, D) — the single new token
+    q: jnp.ndarray,  # (B, S, H, D) — S=1 decode, S=K+1 verify window
     k: jnp.ndarray,  # (B, T, H, D) — the KV cache
     v: jnp.ndarray,  # (B, T, H, D)
-    mask: Optional[jnp.ndarray] = None,  # (B, 1, 1, T), True=attend
+    mask: Optional[jnp.ndarray] = None,  # (B, 1, S, T), True=attend
     dtype: Dtype = jnp.float32,
 ) -> jnp.ndarray:
-    """`dot_product_attention` for the one-token decode step, formulated so
-    its fp32 output is BITWISE-equal to the corresponding row of the full
-    forward on the CPU mesh (the serving parity pin, PARITY.md).
+    """`dot_product_attention` for the cached decode step, formulated so
+    its fp32 output rows are BITWISE-equal to the corresponding rows of
+    the full forward on the CPU mesh (the serving parity pin, PARITY.md).
 
     Same math, one deliberate difference: the weights x V contraction runs
     through an explicit `lax.dot_general` with (B, H) batch dims. The
@@ -108,7 +108,14 @@ def decode_dot_product_attention(
     reassociation noise that would break the decode-vs-full bitwise parity
     contract. The dot_general form accumulates like the GEMM row does
     (pinned empirically by tests/test_serving.py; the QK^T einsum and the
-    softmax are already row-stable at s=1, so they stay as-is)."""
+    softmax are already row-stable at s=1, so they stay as-is).
+
+    The same formulation serves the speculative VERIFY window (S = K+1
+    query rows per slot, serving/speculative.py): every op is
+    row-independent over the query axis, so window row ``j`` under its own
+    causal mask is bitwise the s=1 decode step at that position — the
+    acceptance comparison compares exact tokens, never float
+    intermediates."""
     d = q.shape[-1]
     logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
     logits = logits / np.sqrt(d).astype(np.float32)
@@ -191,16 +198,21 @@ def _dequant_pages(codes: jnp.ndarray, scales: jnp.ndarray,
     return (codes.astype(jnp.float32) * scales[..., None]).astype(dtype)
 
 
-def _quant_rows(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _quant_rows(x: jnp.ndarray, fused: Optional[bool] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """int8-quantize (..., D) through the gradient-wire codec grid: one
     scale per leading row over the trailing D axis — THE same absmax /
     ``max(amax, 1e-30) * (1/127)`` / round/clip grid the wire uses, so the
-    KV-page error model is the wire codec's one-shot bound."""
+    KV-page error model is the wire codec's one-shot bound. ``fused``
+    threads the PR 6 tri-state (None = auto, True = Pallas fused kernel,
+    False = XLA-composed reference) exactly like the wire's
+    ``_quantize_int8_rows`` — the fused kernel is bit-identical by the
+    PR 6 exactness model, so the page bytes do not depend on the flag."""
     from ..parallel.grad_sync import _quantize_int8_rows
 
     lead = x.shape[:-1]
     q, scales = _quantize_int8_rows(
-        x.astype(jnp.float32).reshape(-1, x.shape[-1]))
+        x.astype(jnp.float32).reshape(-1, x.shape[-1]), fused=fused)
     return q.reshape(x.shape), scales.reshape(lead)
 
 
@@ -230,14 +242,16 @@ def gather_paged_kv(pkv: PagedKV, page_table: jnp.ndarray,
 
 def scatter_paged_rows(pkv: PagedKV, page_table: jnp.ndarray,
                        positions: jnp.ndarray, k_rows: jnp.ndarray,
-                       v_rows: jnp.ndarray, active: jnp.ndarray) -> PagedKV:
+                       v_rows: jnp.ndarray, active: jnp.ndarray,
+                       fused: Optional[bool] = None) -> PagedKV:
     """Write ONE fresh (H, D) k/v row per slot per layer — ``k_rows`` /
     ``v_rows`` are (L, rows, H, D) — at that slot's own position: the paged
     decode step's write half, ONE scatter covering every layer.
     ``positions`` (rows,) int32, ``active`` (rows,) bool: inactive rows are
     dropped by pointing their write at an out-of-range page
     (``mode="drop"``), so finished/free slots never touch the pool (the
-    token-granular join/leave substrate)."""
+    token-granular join/leave substrate). ``fused`` is the int8 codec's
+    PR 6 tri-state (`_quant_rows`)."""
     n_pages, ps = pkv.k.shape[1], pkv.k.shape[2]
     rows = positions.shape[0]
     page = page_table[jnp.arange(rows), positions // ps]
@@ -246,7 +260,39 @@ def scatter_paged_rows(pkv: PagedKV, page_table: jnp.ndarray,
 
     def put(store, scale_store, fresh):
         if scale_store is not None:
-            q, s = _quant_rows(fresh)
+            q, s = _quant_rows(fresh, fused=fused)
+            return (store.at[:, page, off].set(q, mode="drop"),
+                    scale_store.at[:, page, off].set(s, mode="drop"))
+        return (store.at[:, page, off].set(fresh.astype(store.dtype),
+                                           mode="drop"), None)
+
+    k, ks = put(pkv.k, pkv.k_scale, k_rows)
+    v, vs = put(pkv.v, pkv.v_scale, v_rows)
+    return PagedKV(k=k, v=v, k_scale=ks, v_scale=vs)
+
+
+def scatter_paged_window(pkv: PagedKV, page_table: jnp.ndarray,
+                         positions: jnp.ndarray, k_rows: jnp.ndarray,
+                         v_rows: jnp.ndarray, active: jnp.ndarray,
+                         fused: Optional[bool] = None) -> PagedKV:
+    """`scatter_paged_rows` generalized to an S-position window per slot:
+    ``positions`` / ``active`` are (rows, S) and ``k_rows`` / ``v_rows``
+    (L, rows, S, H, D) — the speculative VERIFY step's write half (target
+    k/v for the whole K+1 window) and the draft engine's propose-round
+    commit, still ONE scatter covering every layer. Inactive (row, offset)
+    pairs — dead slots, positions past the slot's page span — are dropped
+    exactly like the one-row form; the caller masks out-of-range window
+    positions BEFORE the page lookup here clips them, so a clipped index
+    can never alias a live page."""
+    n_pages, ps = pkv.k.shape[1], pkv.k.shape[2]
+    rows = positions.shape[0]
+    page = page_table[jnp.arange(rows)[:, None], positions // ps]  # (rows, S)
+    page = jnp.where(active, page, n_pages)         # drop inactive writes
+    off = positions % ps
+
+    def put(store, scale_store, fresh):
+        if scale_store is not None:
+            q, s = _quant_rows(fresh, fused=fused)
             return (store.at[:, page, off].set(q, mode="drop"),
                     scale_store.at[:, page, off].set(s, mode="drop"))
         return (store.at[:, page, off].set(fresh.astype(store.dtype),
@@ -259,7 +305,8 @@ def scatter_paged_rows(pkv: PagedKV, page_table: jnp.ndarray,
 
 def scatter_paged_prefill(pkv: PagedKV, page_row: jnp.ndarray,
                           k_seqs: jnp.ndarray, v_seqs: jnp.ndarray,
-                          length: jnp.ndarray) -> PagedKV:
+                          length: jnp.ndarray,
+                          fused: Optional[bool] = None) -> PagedKV:
     """Write one slot's prompt k/v — ``k_seqs`` / ``v_seqs`` (L, S, H, D),
     every layer at once — into its pages, positions [0, length) only: the
     paged prefill's write half. ``page_row`` (P,) is the slot's page-table
@@ -275,7 +322,7 @@ def scatter_paged_prefill(pkv: PagedKV, page_row: jnp.ndarray,
 
     def put(store, scale_store, fresh):
         if scale_store is not None:
-            q, sc = _quant_rows(fresh)
+            q, sc = _quant_rows(fresh, fused=fused)
             return (store.at[:, page, off].set(q, mode="drop"),
                     scale_store.at[:, page, off].set(sc, mode="drop"))
         return (store.at[:, page, off].set(fresh.astype(store.dtype),
@@ -378,11 +425,27 @@ class MultiHeadAttention(nn.Module):
                         cv, v.astype(cv.dtype), (0, 0, 0, 0)))
             else:
                 # decode: per-row scatter at each row's own position, then
-                # attend over the updated cache (q is the single new token)
-                hit = (jnp.arange(ck.shape[1])[None, :]
-                       == cache_positions[:, None])[:, :, None, None]
-                ck = jnp.where(hit, k.astype(ck.dtype), ck)
-                cv = jnp.where(hit, v.astype(cv.dtype), cv)
+                # attend over the updated cache. S == 1 is the classic
+                # one-token step; S > 1 is the speculative VERIFY window
+                # (serving/speculative.py) — window token j lands at
+                # position + j BEFORE attention, and the caller's per-row
+                # causal mask hides the not-yet-committed later rows, so
+                # window row j is bitwise the s=1 step at that position.
+                s_q = q.shape[1]
+                if s_q == 1:
+                    hit = (jnp.arange(ck.shape[1])[None, :]
+                           == cache_positions[:, None])[:, :, None, None]
+                    ck = jnp.where(hit, k.astype(ck.dtype), ck)
+                    cv = jnp.where(hit, v.astype(cv.dtype), cv)
+                else:
+                    for j in range(s_q):
+                        hit = (jnp.arange(ck.shape[1])[None, :]
+                               == (cache_positions + j)[:, None]
+                               )[:, :, None, None]
+                        ck = jnp.where(hit, k[:, j:j + 1].astype(ck.dtype),
+                                       ck)
+                        cv = jnp.where(hit, v[:, j:j + 1].astype(cv.dtype),
+                                       cv)
                 new_cache = (ck, cv)
                 y = decode_dot_product_attention(q, ck, cv, mask=mask,
                                                  dtype=self.dtype)

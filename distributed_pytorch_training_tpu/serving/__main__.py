@@ -24,6 +24,12 @@ Commands:
       over N in-process replicas behind the stdlib router and
       --kill-replica injects one replica death mid-load (every request
       must still complete, recompiles must stay 0).
+      --draft MODEL arms speculative decoding (draft proposes --draft-k
+      tokens, target verifies the K+1 window in one forward; the row
+      gains accept_ratio and the stream stays bitwise the plain arm's);
+      --shared-frac F gives F of the requests one shared prompt — after
+      the primer each admits with ZERO prefill (prefill_skips + the
+      warm/cold TTFT split are the receipts).
   serve [--port P] [--kv-dtype int8] [--page-size N]
       ONE long-lived continuous-batching replica: POST /generate
       ({"tokens": [...], "max_new_tokens"?, "temperature"?, "top_p"?,
@@ -133,6 +139,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--kill-replica", action="store_true",
                    help="bench --continuous --replicas>1: kill replica 0 "
                         "mid-load; the router must resubmit its requests")
+    # speculative decoding + prefix-resident admission (bench --continuous)
+    p.add_argument("--draft", default=None, metavar="MODEL",
+                   help="bench --continuous: arm speculative decoding "
+                        "with this (random-init, smaller) draft LM — "
+                        "fp32 KV only; the emitted streams stay bitwise "
+                        "the plain row's (acceptance is exact match)")
+    p.add_argument("--draft-k", type=int, default=4,
+                   help="draft tokens proposed per slot per verify round")
+    p.add_argument("--shared-frac", type=float, default=0.0,
+                   help="bench --continuous: fraction of requests that "
+                        "share ONE page-aligned prompt — after the "
+                        "primer, each admits with zero prefill dispatch "
+                        "(prefill_skips + warm/cold TTFT in the row)")
+    p.add_argument("--no-prefix-skip", action="store_true",
+                   help="disable the prefix-resident admission fast path "
+                        "(shared pages still dedupe; admission prefills)")
     p.add_argument("--port", type=int, default=8100,
                    help="serve: /generate port (0 = ephemeral, logged); "
                         "fleet: base port — replica r listens on base+r")
@@ -272,10 +294,23 @@ def _run(args, buckets) -> int:
             ckpt_dir=args.ckpt_dir, seed=args.seed,
             optimizer=args.optimizer, momentum=args.momentum,
             weight_decay=args.weight_decay, train_config=train_config,
-            mesh_spec=args.mesh)
+            mesh_spec=args.mesh, draft_model=args.draft,
+            draft_k=args.draft_k, shared_frac=args.shared_frac,
+            prefix_skip=not args.no_prefix_skip)
         if args.as_json:
             print(json.dumps(row, sort_keys=True, default=str))
         else:
+            spec = (f", draft={row['draft']} k={row['draft_k']} "
+                    f"accept {row['accept_ratio']} "
+                    f"({row['accepted_per_verify']} tok/verify)"
+                    if row.get("draft") else "")
+            skip = (f", {row['prefill_skips']} prefill skips / "
+                    f"{row['tail_resumes']} tail resumes"
+                    + (f" (ttft warm {row['ttft_warm_p50_ms']}ms vs "
+                       f"cold {row['ttft_cold_p50_ms']}ms)"
+                       if "ttft_warm_p50_ms" in row else "")
+                    if row.get("prefill_skips") or row.get("tail_resumes")
+                    else "")
             log_main(
                 f"serving bench [token-granular x{row['replicas']}]: "
                 f"{row['model']} kv={row['kv_dtype']} "
@@ -287,7 +322,8 @@ def _run(args, buckets) -> int:
                 f"{row['dense_kv_bytes']}B ({row['kv_bytes_ratio']}x), "
                 f"{row['compiles']} compiles "
                 f"({row['recompiles_after_warmup']} after warmup, "
-                f"{row['replica_deaths']} replica deaths)")
+                f"{row['replica_deaths']} replica deaths)"
+                + spec + skip)
             if row.get("contracts", {}).get("pass") is False:
                 log_main(f"serving bench: CONTRACT VIOLATIONS: "
                          f"{row['contracts']['violations']}")

@@ -64,6 +64,7 @@ from ..models.layers import (
     paged_kv_bytes,
     scatter_paged_prefill,
     scatter_paged_rows,
+    scatter_paged_window,
 )
 from ..parallel.mesh import batch_shard_count
 from ..parallel.sharding import batch_sharding, replicated
@@ -176,6 +177,13 @@ class SlotEngine(InferenceEngine):
             # per-slot output accumulators, fetched ONCE at completion
             "out_buf": jnp.zeros((rows, cfg.max_new_tokens), jnp.int32),
             "last_buf": jnp.zeros((rows, vocab), jnp.float32),
+            # prefix-skip support: the position whose decode logits should
+            # be captured into last_buf (-1 = already captured — the
+            # prefill path writes last_buf itself; a skip-admitted slot
+            # never ran a prefill, so its first decode step captures the
+            # last-prompt logits here, bitwise the prefill's by the
+            # decode-vs-full parity pin)
+            "last_pos": jnp.full((rows,), -1, jnp.int32),
         }
 
     def reset_state(self) -> None:
@@ -242,7 +250,8 @@ class SlotEngine(InferenceEngine):
             k_seqs = jnp.stack([c[0][0] for c in cache])
             v_seqs = jnp.stack([c[1][0] for c in cache])
             new_pool = scatter_paged_prefill(pool, page_row, k_seqs,
-                                             v_seqs, length)
+                                             v_seqs, length,
+                                             fused=cfg.fused_quantize)
             out_row = jnp.zeros((cfg.max_new_tokens,), jnp.int32)
             out_row = out_row.at[0].set(t0)
             control = dict(control)
@@ -255,12 +264,14 @@ class SlotEngine(InferenceEngine):
             control["top_ps"] = control["top_ps"].at[slot].set(top_p)
             control["out_buf"] = control["out_buf"].at[slot].set(out_row)
             control["last_buf"] = control["last_buf"].at[slot].set(last)
+            control["last_pos"] = control["last_pos"].at[slot].set(-1)
             return new_pool, control
 
         return prefill
 
     def _make_paged_decode(self) -> Callable:
         rows = self.config.rows
+        fused = self.config.fused_quantize
 
         def decode(served, pool, control, page_table):
             params = self._dequant(served)
@@ -288,7 +299,8 @@ class SlotEngine(InferenceEngine):
                 jnp.take_along_axis(v_new, idx, axis=1)[:, 0]
                 for _, v_new in new_cache])
             new_pool = scatter_paged_rows(pool, page_table, positions,
-                                          k_rows, v_rows, active)
+                                          k_rows, v_rows, active,
+                                          fused=fused)
             # the token at position p+1, from THIS request's key stream
             step_keys = jax.vmap(jax.random.fold_in)(
                 control["keys"], positions + 1)
@@ -298,12 +310,20 @@ class SlotEngine(InferenceEngine):
             safe_row = jnp.where(active, jnp.arange(rows), rows)
             out_buf = control["out_buf"].at[
                 safe_row, control["emitted"]].set(nxt, mode="drop")
+            # a skip-admitted slot's first step captures the last-prompt
+            # logits the prefill would have stored (bitwise, by the
+            # decode-vs-full parity pin); -1 for everyone else
+            cap = positions == control["last_pos"]
             new_control = dict(control)
             new_control["tok"] = jnp.where(active, nxt, tok)
             new_control["positions"] = positions + act
             new_control["budget"] = control["budget"] - act
             new_control["emitted"] = control["emitted"] + act
             new_control["out_buf"] = out_buf
+            new_control["last_buf"] = jnp.where(
+                cap[:, None], logits[:, 0], control["last_buf"])
+            new_control["last_pos"] = jnp.where(
+                cap, -1, control["last_pos"])
             return new_pool, new_control
 
         return decode
@@ -349,25 +369,161 @@ class SlotEngine(InferenceEngine):
         ).lower(self._served, pool_avals, ctrl_avals,
                 self._row_aval((cfg.rows, cfg.pages_per_slot), jnp.int32))
 
+    # -- prefix-resident admission (ISSUE 19) --------------------------------
+
+    @property
+    def prefix_skip_enabled(self) -> bool:
+        """Whether admission may skip/shorten prefill for resident
+        prefixes. fp32 pools only: an int8 skip would read dequantized
+        pages where the cold prefill reads fresh fp32 — residency would
+        change the emitted stream and break the router's same-seed-retry
+        determinism (PARITY.md documents the exclusion)."""
+        cfg: PagedServeConfig = self.config
+        return (cfg.prefix_sharing and cfg.prefix_skip
+                and cfg.kv_dtype == "fp32")
+
+    def _make_paged_skip(self) -> Callable:
+        cfg: PagedServeConfig = self.config
+
+        def skip(control, slot, last_tok, length, want, key, temp, top_p):
+            # Fully resident prompt: no forward at all. The slot enters
+            # the shared decode step at position length-1 holding the last
+            # prompt token; that step rewrites the resident row with its
+            # own bytes (idempotent — the prefix-sharing safety argument),
+            # samples token #0 with fold_in(key, length) exactly like the
+            # prefill path, and captures the last-prompt logits via
+            # last_pos. budget = want (nothing emitted yet), vs the
+            # prefill path's want - 1.
+            control = dict(control)
+            control["tok"] = control["tok"].at[slot].set(last_tok)
+            control["positions"] = control["positions"].at[slot].set(
+                length - 1)
+            control["budget"] = control["budget"].at[slot].set(want)
+            control["emitted"] = control["emitted"].at[slot].set(0)
+            control["keys"] = control["keys"].at[slot].set(key)
+            control["temps"] = control["temps"].at[slot].set(temp)
+            control["top_ps"] = control["top_ps"].at[slot].set(top_p)
+            control["out_buf"] = control["out_buf"].at[slot].set(
+                jnp.zeros((cfg.max_new_tokens,), jnp.int32))
+            control["last_pos"] = control["last_pos"].at[slot].set(
+                length - 1)
+            return control
+
+        return skip
+
+    def _make_paged_resume(self, bucket: int) -> Callable:
+        """Tail-only prefill for a PARTIALLY resident prompt: feed just
+        the uncovered suffix through the verify-window decode mode at
+        offset ``start`` — each tail row attends the resident pages plus
+        the in-window causal prefix, so its logits (and written k/v) are
+        bitwise the full prefill's rows (the window parity pin)."""
+        cfg: PagedServeConfig = self.config
+        fused = cfg.fused_quantize
+
+        def resume(served, pool, control, page_table, ids, start, length,
+                   slot, want, key, temp, top_p):
+            params = self._dequant(served)
+            row_tbl = jax.lax.dynamic_slice_in_dim(page_table, slot, 1, 0)
+            k_all, v_all = gather_paged_kv(pool, row_tbl,
+                                           dtype=self.model.dtype)
+            cache = tuple((k_all[l], v_all[l])
+                          for l in range(self.model.depth))
+            logits, new_cache = self.model.apply(
+                self._apply_vars(params), ids, train=False, cache=cache,
+                cache_positions=start[None])
+            tail = length - start
+            last = jnp.take(logits[0], jnp.maximum(tail - 1, 0), axis=0)
+            k0 = jax.random.fold_in(key, length)
+            t0 = sample_tokens(last[None, :], k0[None, :], temp[None],
+                               top_p[None])[0]
+            # commit the tail k/v rows at positions [start, length)
+            win_pos = (start + jnp.arange(bucket))[None, :]     # (1, S)
+            idxc = jnp.clip(win_pos[0], 0, self.padded_len - 1)
+            k_wins = jnp.stack([jnp.take_along_axis(
+                c[0], idxc[None, :, None, None], axis=1) for c in new_cache
+            ])                                        # (L, 1, S, H, D)
+            v_wins = jnp.stack([jnp.take_along_axis(
+                c[1], idxc[None, :, None, None], axis=1) for c in new_cache
+            ])
+            act = (win_pos < length) & (win_pos < self.padded_len)
+            new_pool = scatter_paged_window(pool, row_tbl, win_pos, k_wins,
+                                            v_wins, act, fused=fused)
+            out_row = jnp.zeros((cfg.max_new_tokens,), jnp.int32)
+            out_row = out_row.at[0].set(t0)
+            control = dict(control)
+            control["tok"] = control["tok"].at[slot].set(t0)
+            control["positions"] = control["positions"].at[slot].set(length)
+            control["budget"] = control["budget"].at[slot].set(want - 1)
+            control["emitted"] = control["emitted"].at[slot].set(1)
+            control["keys"] = control["keys"].at[slot].set(key)
+            control["temps"] = control["temps"].at[slot].set(temp)
+            control["top_ps"] = control["top_ps"].at[slot].set(top_p)
+            control["out_buf"] = control["out_buf"].at[slot].set(out_row)
+            control["last_buf"] = control["last_buf"].at[slot].set(last)
+            control["last_pos"] = control["last_pos"].at[slot].set(-1)
+            return new_pool, control
+
+        return resume
+
+    def lower_paged_skip(self):
+        """The lowered control-only skip admission — every knob traced,
+        control DONATED (no pool, no forward: the zero-dispatch path)."""
+        ctrl_avals = self._control_avals()
+        scalar_i = self._rep_aval((), jnp.int32)
+        scalar_f = self._rep_aval((), jnp.float32)
+        return jax.jit(
+            self._make_paged_skip(), donate_argnums=(0,),
+            out_shardings=self._out_shardings(ctrl_avals),
+        ).lower(ctrl_avals, scalar_i, scalar_i, scalar_i, scalar_i,
+                self._rep_aval((2,), jnp.uint32), scalar_f, scalar_f)
+
+    def lower_paged_resume(self, bucket: int):
+        """The lowered tail-only prefill (partial residency) — pool +
+        control DONATED like the full prefill's."""
+        cfg: PagedServeConfig = self.config
+        pool_avals = self._pool_avals()
+        ctrl_avals = self._control_avals()
+        scalar_i = self._rep_aval((), jnp.int32)
+        scalar_f = self._rep_aval((), jnp.float32)
+        outs = (pool_avals, ctrl_avals)
+        return jax.jit(
+            self._make_paged_resume(bucket), donate_argnums=(1, 2),
+            out_shardings=self._out_shardings(outs),
+        ).lower(self._served, pool_avals, ctrl_avals,
+                self._row_aval((cfg.rows, cfg.pages_per_slot), jnp.int32),
+                self._rep_aval((1, bucket), jnp.int32),
+                scalar_i, scalar_i, scalar_i, scalar_i,
+                self._rep_aval((2,), jnp.uint32), scalar_f, scalar_f)
+
     def _executable(self, kind: str, bucket: int):
-        if kind not in ("paged_prefill", "paged_decode"):
+        if kind not in ("paged_prefill", "paged_decode", "paged_skip",
+                        "paged_resume"):
             return super()._executable(kind, bucket)
         key = (kind, bucket)
         if key not in self._compiled:
-            lowered = (self.lower_paged_prefill(bucket)
-                       if kind == "paged_prefill"
-                       else self.lower_paged_decode())
+            lowered = {
+                "paged_prefill": lambda: self.lower_paged_prefill(bucket),
+                "paged_decode": self.lower_paged_decode,
+                "paged_skip": self.lower_paged_skip,
+                "paged_resume": lambda: self.lower_paged_resume(bucket),
+            }[kind]()
             with telemetry.span("compile", program=kind, bucket=bucket):
                 self._compiled[key] = lowered.compile()
             self.compiles += 1
         return self._compiled[key]
 
     def warmup(self) -> int:
-        """Compile the decode step + every bucket's prefill up front; the
-        census is flat from here (the zero-recompile acceptance)."""
+        """Compile the decode step + every bucket's prefill (and, when
+        prefix skip is live, the skip + per-bucket tail-resume programs)
+        up front; the census is flat from here (the zero-recompile
+        acceptance)."""
         self._executable("paged_decode", 0)
         for b in self.config.buckets:
             self._executable("paged_prefill", b)
+        if self.prefix_skip_enabled:
+            self._executable("paged_skip", 0)
+            for b in self.config.buckets:
+                self._executable("paged_resume", b)
         return self.compiles
 
     # -- the three runtime entries (scheduler-facing) ------------------------
@@ -391,6 +547,43 @@ class SlotEngine(InferenceEngine):
             self._served, self._pool, self._control, self._table_dev,
             dev(ids), dev(np.int32(len(tokens))), dev(np.int32(slot)),
             dev(np.int32(want)), dev(key),
+            dev(np.float32(temperature)), dev(np.float32(top_p)))
+        return bucket
+
+    def admit_skip(self, slot: int, last_tok: int, length: int, want: int,
+                   temperature: float, top_p: float, seed: int) -> None:
+        """Admit a FULLY prefix-resident request with no forward at all:
+        one control-only program arms the slot to enter the shared decode
+        step at the resumed position (see `_make_paged_skip` — token #0
+        and the last-prompt logits come out of that step, bitwise the
+        prefill path's)."""
+        key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        dev = lambda x: jax.device_put(x, self._rep)  # noqa: E731
+        exe = self._executable("paged_skip", 0)
+        self._control = exe(
+            self._control, dev(np.int32(slot)), dev(np.int32(last_tok)),
+            dev(np.int32(length)), dev(np.int32(want)), dev(key),
+            dev(np.float32(temperature)), dev(np.float32(top_p)))
+
+    def admit_resume(self, slot: int, tokens: np.ndarray, start: int,
+                     want: int, temperature: float, top_p: float,
+                     seed: int) -> int:
+        """Admit a PARTIALLY resident request: prefill only the uncovered
+        tail ``tokens[start:]`` through the tail bucket's resume program
+        (verify-window forward at offset ``start`` over the resident
+        pages). Returns the tail bucket served."""
+        cfg: PagedServeConfig = self.config
+        tail = tokens[start:]
+        bucket = bucket_for(len(tail), cfg.buckets)
+        ids = np.full((1, bucket), cfg.pad_id, np.int32)
+        ids[0, :len(tail)] = tail
+        key = np.asarray(jax.random.PRNGKey(int(seed)), np.uint32)
+        dev = lambda x: jax.device_put(x, self._rep)  # noqa: E731
+        exe = self._executable("paged_resume", bucket)
+        self._pool, self._control = exe(
+            self._served, self._pool, self._control, self._table_dev,
+            dev(ids), dev(np.int32(start)), dev(np.int32(len(tokens))),
+            dev(np.int32(slot)), dev(np.int32(want)), dev(key),
             dev(np.float32(temperature)), dev(np.float32(top_p)))
         return bucket
 
@@ -471,6 +664,10 @@ class ContinuousScheduler:
         # max decode steps per fence when nothing is waiting to join
         # (see step()); 1 restores strict fence-per-token behavior
         self.burst_steps = 4
+        # prefix-resident admission census (ISSUE 19): how many
+        # admissions skipped prefill entirely vs prefilled only a tail
+        self.prefill_skips = 0                              # guarded-by: _lock
+        self.tail_resumes = 0                               # guarded-by: _lock
 
     # -- admission -----------------------------------------------------------
 
@@ -488,7 +685,15 @@ class ContinuousScheduler:
     def _try_admit(self, req: Request) -> bool:   # lock-held: _lock
         """One admission attempt: needs a free slot AND a page lease.
         False means 'not now' (the request stays pending) — admission
-        pressure is absorbed here, never by a recompile."""
+        pressure is absorbed here, never by a recompile.
+
+        With prefix skip live (fp32 pools, `prefix_skip_enabled`), the
+        lease's shared-page count decides the prefill's fate: covered >=
+        len(prompt) - 1 positions resident -> NO prefill dispatch at all
+        (the slot enters decode at the resumed position; the at-most-one
+        uncovered position is the one the first decode step writes
+        anyway); partially covered -> a tail-only prefill over just the
+        fresh pages. Cold prompts take the classic full prefill."""
         if not self.free_slots:
             return False
         cfg: PagedServeConfig = self.engine.config
@@ -498,24 +703,71 @@ class ContinuousScheduler:
         lease = self.pool.alloc(req.tokens, len(req.tokens) + want)
         if lease is None:
             return False
+        if not self._draft_admit(req, lease, want):
+            # rollback, NOT release: the lease's fresh pages were
+            # hash-registered at alloc time but never prefilled — a
+            # plain release would park them as "resident" and a retry
+            # of the same prompt would skip-admit onto garbage KV
+            self.pool.rollback(lease)
+            return False
         slot = self.free_slots.pop()
         self.engine.set_page_row(slot, lease.pages)
+        n = len(req.tokens)
+        covered = len(lease.shared) * cfg.page_size
         t0 = time.perf_counter()
-        bucket = self.engine.admit(slot, req.tokens, want, req.temperature,
-                                   req.top_p, req.seed)
+        skip_ok = getattr(self.engine, "prefix_skip_enabled", False)
+        if skip_ok and covered >= n - 1 and covered > 0:
+            self.engine.admit_skip(slot, int(req.tokens[-1]), n, want,
+                                   req.temperature, req.top_p, req.seed)
+            bucket = bucket_for(n, cfg.buckets)
+            left = want   # nothing emitted yet: decode emits all `want`
+            self.prefill_skips += 1
+            telemetry.span_event("prefill_skip", time.perf_counter() - t0,
+                                 slot=slot, request=req.id,
+                                 resident=covered)
+        elif skip_ok and covered > 0:
+            bucket = self.engine.admit_resume(
+                slot, req.tokens, covered, want, req.temperature,
+                req.top_p, req.seed)
+            left = want - 1
+            self.tail_resumes += 1
+            telemetry.span_event("prefill", time.perf_counter() - t0,
+                                 bucket=bucket, slot=slot, request=req.id,
+                                 resumed=covered)
+        else:
+            bucket = self.engine.admit(slot, req.tokens, want,
+                                       req.temperature, req.top_p,
+                                       req.seed)
+            left = want - 1
+            telemetry.span_event("prefill", time.perf_counter() - t0,
+                                 bucket=bucket, slot=slot, request=req.id)
         now = time.perf_counter()
-        # t_first_token stays None until the NEXT step fence — admit()
-        # only dispatched the prefill; step() stamps it once the fence
-        # proves token #0 landed. The span here is the dispatch cost.
-        telemetry.span_event("prefill", now - t0, bucket=bucket, slot=slot,
-                             request=req.id)
+        # t_first_token stays None until the NEXT step fence — admission
+        # only dispatched device work; step() stamps it once the fence
+        # proves token #0 landed. The spans above are the dispatch cost.
         telemetry.span_event(
             "slot_wait", now - self._t_popped.pop(req.id, now),
             request=req.id, slot=slot)
         self.running[slot] = _SlotState(req=req, lease=lease, bucket=bucket,
-                                        want=want, left=want - 1)
+                                        want=want, left=left)
+        self._post_admit(slot, req)
         self._gauges()
         return True
+
+    def _draft_admit(self, req: Request, lease: PageLease,
+                     want: int) -> bool:   # lock-held: _lock
+        """Speculative hook: lease + prefill the DRAFT pool for this
+        request before the target admission commits (False aborts the
+        attempt — the target lease is rolled back). The plain scheduler
+        has no draft."""
+        return True
+
+    def _post_admit(self, slot: int, req: Request) -> None:  # lock-held: _lock
+        """Speculative hook: called once the target admission landed in
+        ``running`` (the draft engine points its page row here)."""
+
+    def _post_complete(self, slot: int) -> None:   # lock-held: _lock
+        """Speculative hook: a slot finished — release its draft lease."""
 
     def _admit_pending(self) -> None:   # lock-held: _lock
         still: List[Request] = []
@@ -550,6 +802,19 @@ class ContinuousScheduler:
                 if st.left > 0:
                     st.left -= 1
 
+    def _advance(self) -> None:   # lock-held: _lock
+        """Advance every live slot: the plain scheduler runs 1..burst
+        compiled decode steps (one token each); the speculative scheduler
+        (serving/speculative.py) overrides this with one draft-propose +
+        verify round (up to K+1 tokens per fence). Either way the caller
+        fences afterwards and completes finished slots."""
+        steps = 1
+        if not self.pending and not len(self.queue):
+            steps = max(1, min(min(st.left for st in
+                                   self.running.values()),
+                               self.burst_steps))
+        self._step_decode_loop(steps)
+
     def _complete_finished(self) -> None:   # lock-held: _lock
         t0 = time.perf_counter()
         done = [slot for slot, st in self.running.items() if st.left == 0]
@@ -566,6 +831,7 @@ class ContinuousScheduler:
             self.pool.release(st.lease)
             self.engine.set_page_row(
                 slot, np.zeros(self.engine.config.pages_per_slot, np.int32))
+            self._post_complete(slot)
             self.free_slots.append(slot)
             st.req.set_result(res)
             self.served += 1
@@ -607,12 +873,7 @@ class ContinuousScheduler:
             self._pull()
             self._admit_pending()
             if self.running:
-                steps = 1
-                if not self.pending and not len(self.queue):
-                    steps = max(1, min(min(st.left for st in
-                                           self.running.values()),
-                                       self.burst_steps))
-                self._step_decode_loop(steps)
+                self._advance()
                 jax.block_until_ready(self.engine._control["tok"])
                 # the fence proves every dispatched prefill's token #0
                 # landed: the honest (if slightly late) TTFT stamp

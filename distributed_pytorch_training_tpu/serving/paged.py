@@ -64,6 +64,21 @@ class PagedServeConfig(ServeConfig):
     n_pages: int = 0
     kv_dtype: str = "fp32"
     prefix_sharing: bool = True
+    # Prefix-resident admission (ISSUE 19): when a prompt's leading pages
+    # are already resident (prefix sharing mapped them), admission skips
+    # the prefill dispatch — fully resident prompts go straight into
+    # decode at the resumed position, partially resident ones prefill
+    # only the fresh tail. fp32 pools only: the skip path's token #0
+    # reads the dequantized pages where the cold prefill reads fresh
+    # fp32, so an int8 skip could emit a different stream on a resident
+    # vs cold replica and break the router's same-seed-retry invariant
+    # (serving/continuous.py applies the gate).
+    prefix_skip: bool = True
+    # PR 6 fused-quantize tri-state for the int8 page codec: None = auto
+    # (DPT_FUSED_QUANTIZE env, else TPU-only), True/False = forced. The
+    # fused kernel is bit-identical to the XLA-composed reference
+    # (ops/quantize.py), so this flips kernels, never page bytes.
+    fused_quantize: Optional[bool] = None
 
     def __post_init__(self):
         super().__post_init__()
@@ -217,6 +232,23 @@ class PagePool:
         with self._lock:
             for page in lease.pages[:lease.n_pages]:
                 self._release_page(int(page))
+
+    def rollback(self, lease: PageLease) -> None:
+        """Undo an alloc whose admission ABORTED before any prefill
+        dispatched (e.g. the draft pool refused its half). The lease's
+        FRESH pages were hash-registered for sharing at alloc time but
+        never written — a later identical prompt matching them would
+        skip-admit onto garbage, so their hashes must be forgotten here.
+        Pages this alloc matched as shared were written by an earlier
+        admission and just release normally."""
+        shared = set(map(int, lease.shared))
+        with self._lock:
+            for page in map(int, lease.pages[:lease.n_pages]):
+                if page not in shared:
+                    h = self._hash_of.pop(page, None)
+                    if h is not None:
+                        self._by_hash.pop(h, None)
+                self._release_page(page)
 
     # -- observability -------------------------------------------------------
 

@@ -29,6 +29,7 @@ module only routes.
 
 from __future__ import annotations
 
+import http.client
 import json
 import re
 import socket
@@ -60,13 +61,22 @@ class InProcessReplica:
     scheduler fails everything in flight with `ReplicaDead`, the router
     resubmits), `stop` is the drain path."""
 
-    def __init__(self, name: str, engine, start: bool = True):
+    def __init__(self, name: str, engine, start: bool = True,
+                 scheduler_cls=None):
         from .continuous import ContinuousScheduler
 
+        if scheduler_cls is None:
+            # a SpeculativeEngine under the plain scheduler would decode
+            # token-at-a-time and never touch the draft — auto-pair the
+            # engine with the scheduler that drives its verify loop
+            from .speculative import SpeculativeEngine, SpeculativeScheduler
+            scheduler_cls = (SpeculativeScheduler
+                             if isinstance(engine, SpeculativeEngine)
+                             else ContinuousScheduler)
         self.name = name
         self.engine = engine
         self.queue = RequestQueue(engine.config.buckets)
-        self.scheduler = ContinuousScheduler(engine, self.queue)
+        self.scheduler = scheduler_cls(engine, self.queue)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self.scheduler.run, args=(self._stop,),
@@ -176,7 +186,26 @@ class _HttpPending:
         try:
             with urllib.request.urlopen(
                     req, timeout=timeout or self.replica.timeout_s) as resp:
-                out = json.loads(resp.read().decode())
+                # read INCREMENTALLY: a replica dying mid-response must
+                # surface now, as a death, not at the request timeout.
+                # A chunk-boundary reset raises (IncompleteRead /
+                # ConnectionResetError — both handled below); a clean
+                # close short of Content-Length is the same half-response
+                # and is promoted to IncompleteRead here, because
+                # json.loads on a truncated body would misreport a dead
+                # replica as a protocol bug
+                want = resp.headers.get("Content-Length")
+                chunks = []
+                while True:
+                    chunk = resp.read(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                raw = b"".join(chunks)
+                if want is not None and len(raw) < int(want):
+                    raise http.client.IncompleteRead(
+                        raw, int(want) - len(raw))
+                out = json.loads(raw.decode())
         except (TimeoutError, socket.timeout) as e:
             # a slow read is NOT a death: the replica is healthy but
             # busy, and resubmitting would stack a duplicate in-flight
@@ -184,6 +213,15 @@ class _HttpPending:
             raise TimeoutError(
                 f"replica {self.replica.name}: no response within "
                 f"{timeout or self.replica.timeout_s}s") from e
+        except http.client.HTTPException as e:
+            # half-response (IncompleteRead) or a torn status line: the
+            # process died mid-POST — resubmit elsewhere immediately
+            # (the route-time-pinned seed makes the retry emit the
+            # identical stream)
+            self.replica._last_ok = False
+            raise ReplicaDead(
+                f"replica {self.replica.name}: died mid-response "
+                f"({type(e).__name__}: {e})") from e
         except (OSError, urllib.error.URLError) as e:
             reason = getattr(e, "reason", None)
             if isinstance(reason, (TimeoutError, socket.timeout)):

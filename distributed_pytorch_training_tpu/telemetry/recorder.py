@@ -85,9 +85,15 @@ SPAN_NAMES = ("data_wait", "step_dispatch", "device_sync", "eval",
 # `slot_wait` (popped from the queue -> admitted into a slot — the
 # pool/page-pressure share of latency, distinct from queue_wait's
 # load share) and `router_dispatch` (the multi-replica router's pick +
-# submit wall, including health probes).
+# submit wall, including health probes). The speculative path (ISSUE 19)
+# adds three more: `draft_decode` (draft prefill + propose-round
+# dispatch), `spec_verify` (the K+1-window target forward), and
+# `prefill_skip` (a prefix-resident admission that dispatched NO
+# prefill — its near-zero wall IS the TTFT win, and its count is the
+# zero-dispatch census the skip test pins).
 SERVING_SPAN_NAMES = ("queue_wait", "prefill", "decode", "drain",
-                      "slot_wait", "router_dispatch")
+                      "slot_wait", "router_dispatch", "draft_decode",
+                      "spec_verify", "prefill_skip")
 
 # The elastic phases (ISSUEs 11 + 12): mesh re-planning after a replica
 # death, the checkpoint reshard (N -> M re-slice), the grow-side live

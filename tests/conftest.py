@@ -74,6 +74,12 @@ _FILE_BUDGETS_S = {
     # with sub-second waits — the budget driver is the sum of the small
     # join timeouts, which accrete per interleaving test.
     "test_analysis_concurrency.py": 60.0,   # measured ~7 s fast
+    # The speculative-decoding suite (ISSUE 19): one SpeculativeEngine
+    # warmup (draft prefill + propose + verify per bucket) plus a plain
+    # SlotEngine warmup for the bitwise cross-pins, an oracle-draft
+    # engine, and one contract evaluation — warmup compile count is the
+    # budget driver, so a new engine or bucket rung names itself here.
+    "test_speculative.py": 180.0,      # measured ~48 s fast
 }
 _file_seconds: dict = {}
 
@@ -108,18 +114,45 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
                 "not enforced on this run)")
 
 
+@pytest.hookimpl(trylast=True)
 def pytest_sessionfinish(session, exitstatus):
-    if not _budget_enforced(session.config):
+    global _final_exitstatus
+    if _budget_enforced(session.config):
+        over = {f: s for f, s in _file_seconds.items()
+                if s > _FILE_BUDGETS_S[f]}
+        if over and session.exitstatus == 0:
+            for fname, secs in over.items():
+                print(f"BUDGET: {fname} took {secs:.1f}s, over its "
+                      f"{_FILE_BUDGETS_S[fname]:.0f}s fast-suite budget "
+                      "— a chaos leg grew past the tier-1 allowance; "
+                      "mark it slow or shrink it", flush=True)
+            session.exitstatus = 1
+    _final_exitstatus = int(session.exitstatus)
+
+
+_final_exitstatus = None
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    """Skip interpreter teardown once the run is reported.
+
+    A full fast-suite run leaves hundreds of compiled XLA executables
+    and device buffers behind; their destructors cost ~8-10 s of wall
+    AFTER the final summary prints — time that counts against the 870 s
+    tier-1 timeout and buys zero coverage. The summary and the final
+    exit status are settled by this point (terminal reporting is a
+    sessionfinish hookwrapper, unconfigure runs after it), so leave via
+    os._exit. DPT_NO_FAST_EXIT=1 restores the normal shutdown (atexit
+    consumers, debugging); coverage runs keep it automatically."""
+    import sys
+    if _final_exitstatus is None or os.environ.get("DPT_NO_FAST_EXIT"):
         return
-    over = {f: s for f, s in _file_seconds.items()
-            if s > _FILE_BUDGETS_S[f]}
-    if over and session.exitstatus == 0:
-        for fname, secs in over.items():
-            print(f"BUDGET: {fname} took {secs:.1f}s, over its "
-                  f"{_FILE_BUDGETS_S[fname]:.0f}s fast-suite budget — a "
-                  "chaos leg grew past the tier-1 allowance; mark it "
-                  "slow or shrink it", flush=True)
-        session.exitstatus = 1
+    if config.pluginmanager.hasplugin("_cov"):
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_final_exitstatus)
 
 
 @pytest.fixture(scope="session")

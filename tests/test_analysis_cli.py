@@ -29,6 +29,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "fsdp", "fsdp_accum", "fsdp_int8_mh",
                              "fsdp_tp", "fsdp_tp_int8_mh",
                              "serving_decode", "serving_paged",
+                             "serving_spec",
                              "elastic_reshard",
                              "elastic_grow"}
     assert all(s == "pass" for s in statuses.values()), statuses
@@ -50,6 +51,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "fsdp-gather-rides-data-only" in kinds
     assert "span-names-registered" in kinds
     assert "profiler-session-via-stepprofiler-only" in kinds
+    # the speculative verify-path donation rule (ISSUE 19)
+    assert "spec-verify-donated" in kinds
     # the concurrency discipline pass (ISSUE 18)
     assert "guarded-by" in kinds
     assert "lock-order-acyclic" in kinds
