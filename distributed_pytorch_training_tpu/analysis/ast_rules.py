@@ -764,6 +764,64 @@ def check_span_names_registered(ctx: FileContext) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# Rule 9: control decisions reach the re-plan surface only via apply.py
+# ---------------------------------------------------------------------------
+
+# The one sanctioned home of re-plan calls from the control package:
+# control/apply.py (apply_decision — the contract-gated commit point).
+# Matched on exact trailing path components like OS_EXIT_HOME.
+CONTROL_APPLY_HOME = ("control", "apply.py")
+
+# The re-plan surface: the Supervisor's boundary commit points, the
+# elastic re-plan primitives they ride, and the armed callbacks. A
+# reference to ANY of these from a control/ module other than apply.py
+# is a policy resharding the fleet directly.
+_REPLAN_SURFACE = frozenset({
+    "boundary_shrink", "boundary_retune", "reshard_train_state",
+    "plan_elastic_world", "replan_cb", "retune_cb", "_replan",
+    "_maybe_grow",
+})
+
+
+@rule("control-decisions-gated", "ast",
+      "control/ modules reach the re-plan surface (boundary_shrink / "
+      "boundary_retune / reshard_train_state / plan_elastic_world / the "
+      "replan callbacks) only through control/apply.py",
+      "control/ is split by contract: policies (straggler.py, tuner.py, "
+      "autopilot.py) measure and PROPOSE; only apply.py COMMITS, because "
+      "apply_decision is where the contract gate and the decision log "
+      "live. A policy calling boundary_shrink or reshard_train_state "
+      "directly reshapes the fleet with no gate run and no ControlDecision "
+      "emitted — the exact ungoverned mutation the control plane exists "
+      "to prevent. Flagged on the reference (Name or Attribute), not just "
+      "calls: `commit = sup.boundary_shrink` then `commit(...)` is the "
+      "same bypass with one extra hop.")
+def check_control_decisions_gated(ctx: FileContext) -> List[Finding]:
+    parts = tuple(ctx.relpath.replace("\\", "/").split("/"))
+    if len(parts) < 2 or parts[-2] != "control":
+        return []
+    if parts[-2:] == CONTROL_APPLY_HOME:
+        return []
+    name = "control-decisions-gated"
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        hit: Optional[str] = None
+        if isinstance(node, ast.Attribute) and node.attr in _REPLAN_SURFACE:
+            hit = node.attr
+        elif isinstance(node, ast.Name) and node.id in _REPLAN_SURFACE:
+            hit = node.id
+        if hit is not None:
+            out.append(Finding(
+                name,
+                f"`{hit}` referenced from a control/ policy module — the "
+                "re-plan surface is reachable from control/ only through "
+                "apply.py's apply_decision (the contract gate + decision "
+                "log); emit a ControlDecision and let the Supervisor's "
+                "boundary hook commit it", ctx.loc(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Engine
 # ---------------------------------------------------------------------------
 

@@ -333,6 +333,20 @@ CONTRACT_MATRIX: Tuple[Contract, ...] = (
              "lower_spec_verify)",
              config=dict(serving_spec=True, donate_state=True),
              kind="serving_spec"),
+    # The control re-plan base contract (ISSUE 20): the config the online
+    # perf tuner's candidates are evaluated AGAINST. control/apply.py
+    # contract_gate overlays a candidate's overrides (wire_dtype /
+    # bucket_cap_mb / overlap_grad_sync / grad_accum — tuner.TUNABLE_KEYS)
+    # on this base and runs the FULL HLO rule set over the lowered
+    # result; any finding (or a config that cannot even lower) refuses
+    # the candidate and the run keeps its old config. The base uses the
+    # explicit bucketed reducer so a candidate's bucket-cap/wire choice
+    # actually changes the lowered collectives the rules see.
+    Contract("control_replan",
+             "base config the online tuner's candidates overlay: "
+             "bucketed fp32 reducer whose every candidate override must "
+             "re-pass the full rule set before apply_decision commits it",
+             config=dict(bucket_cap_mb=_CAP), min_shards=2),
     # The elastic-reshard contract (ISSUE 11): a state resharded N -> M by
     # resilience.elastic must lower to EXACTLY the HLO census a clean-at-M
     # state lowers to — a reshard that lands a leaf replicated (or in any

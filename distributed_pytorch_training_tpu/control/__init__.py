@@ -1,0 +1,38 @@
+"""control/ — the self-driving fleet (ISSUE 20).
+
+The observability plane measures (stragglers, exposed-comm ratio,
+capacity); the control plane can act (reshard, grow, shrink, relaunch);
+this package is the policy layer between them, with three disciplines:
+
+* **every decision is a record** — one typed
+  :class:`~.decisions.ControlDecision` per action (detect / evict /
+  grow / retune / refuse), emitted on the telemetry stream, rendered by
+  ``telemetry summary``, counted on ``/metrics``;
+* **every commit is gated** — the ONLY path from policy to the
+  Supervisor's re-plan surface is :func:`~.apply.apply_decision`
+  (enforced by the ``control-decisions-gated`` analysis rule), and
+  tuner candidates must pass the ``control_replan`` contract before
+  they touch the run;
+* **every action lands at a segment boundary** — the drained,
+  checkpoint-anchored point elastic resizes already use, so control
+  never changes the numerics of a segment in flight (PARITY.md).
+
+Proven end to end by ``resilience chaos --autopilot``: an injected
+persistent loader-stall straggler is named, evicted (shrink via the
+elastic path), re-admitted when capacity returns, and the post-resize
+segment is bitwise against a clean same-seed continuation.
+"""
+
+from __future__ import annotations
+
+from .apply import BASE_CONTRACT, apply_decision, contract_gate  # noqa: F401
+from .autopilot import Autopilot  # noqa: F401
+from .decisions import (  # noqa: F401
+    CONTROL_DECISION_KIND,
+    DECISION_ACTIONS,
+    ControlDecision,
+    emit_decision,
+)
+from .probe import FileCapacityFeed, heartbeat_capacity_probe  # noqa: F401
+from .straggler import StragglerEvictionPolicy  # noqa: F401
+from .tuner import TUNABLE_KEYS, PerfTuner  # noqa: F401

@@ -1,0 +1,155 @@
+"""`apply_decision` — the ONE entry from policy to the re-plan surface.
+
+Every other module in control/ measures, accumulates, and proposes;
+this one commits. The split is enforced, not aspirational: the
+``control-decisions-gated`` analysis rule (analysis/ast_rules.py) flags
+any call into the Supervisor/trainer re-plan surface
+(``boundary_shrink`` / ``boundary_retune`` / ``reshard_train_state`` /
+``plan_elastic_world`` / the replan callbacks) from a control/ module
+other than this file — a policy that resharded the fleet directly would
+bypass the contract gate and the decision log at once.
+
+Gating:
+
+* ``evict`` goes straight to ``Supervisor.boundary_shrink`` — a shrink
+  re-uses the elastic re-plan path whose census identity the
+  ``elastic_reshard``/``elastic_grow`` contracts already pin, so there
+  is nothing new to lower. The Supervisor still refuses (decision
+  ``applied=False``) when the shrink is not viable: no smaller world
+  divides the batch, or the boundary checkpoint did not anchor.
+* ``retune`` must first pass :func:`contract_gate`: the candidate
+  overrides are applied to the ``control_replan`` base contract and the
+  full HLO rule set runs over the lowered result. ANY finding — or a
+  config the matrix cannot even lower — refuses the candidate with a
+  logged ``refuse`` decision and the run continues on the old config.
+
+Both paths emit the finalized :class:`~.decisions.ControlDecision`
+(applied or refused) inside a ``control_apply`` span, so the stream
+shows the gate's wall time next to its verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import telemetry as _telemetry
+from .decisions import ControlDecision, emit_decision
+from .tuner import TUNABLE_KEYS
+
+# The contract the tuner's candidates are evaluated as overrides of
+# (analysis/contracts.py CONTRACT_MATRIX).
+BASE_CONTRACT = "control_replan"
+
+GateResult = Tuple[bool, List[str]]
+
+
+def contract_gate(overrides: Dict[str, Any],
+                  base_contract: str = BASE_CONTRACT) -> GateResult:
+    """Evaluate a candidate config against the contract matrix.
+
+    Returns ``(ok, refusals)``: ``ok`` only when the candidate lowered
+    AND every HLO rule passed. Refusals carry the findings (or the
+    lowering error) verbatim — they become the ``refuse`` decision's
+    evidence. A candidate touching a non-tunable key is refused without
+    lowering anything."""
+    bad = sorted(k for k in overrides if k not in TUNABLE_KEYS)
+    if bad:
+        return False, [f"non-tunable override key(s) {bad} "
+                       f"(knobs: {TUNABLE_KEYS})"]
+    from ..analysis.contracts import get_contract
+    from ..analysis.hlo_rules import run_contract_matrix
+
+    base = get_contract(base_contract)
+    candidate = dataclasses.replace(
+        base, name=f"{base.name}_candidate",
+        config={**base.config, **overrides})
+    try:
+        findings, statuses = run_contract_matrix(contracts=[candidate])
+    except Exception as e:  # a config the matrix cannot even lower
+        return False, [f"{type(e).__name__}: {e}"]
+    refusals = [str(f) for f in findings]
+    status = statuses.get(candidate.name, "missing")
+    if status != "pass":
+        refusals.append(f"contract status: {status}")
+    return (not refusals), refusals
+
+
+def apply_decision(supervisor, decision: ControlDecision, *, report,
+                   state, epoch: int, step: int,
+                   gate: Optional[Callable[[Dict[str, Any]], GateResult]]
+                   = None) -> Tuple[Any, ControlDecision]:
+    """Commit (or refuse) one decision; returns ``(state, finalized)``.
+
+    ``state`` is the live train state at the segment boundary —
+    returned resharded/adopted when the action applied, unchanged when
+    it was refused or deferred. The finalized decision (the one actually
+    emitted) records ``applied`` and the worlds it moved between;
+    refusals are emitted as action ``refuse`` with the original action
+    and the gate's findings in the evidence."""
+    if gate is None:
+        gate = contract_gate
+    with _telemetry.span("control_apply", action=decision.action):
+        if decision.action == "evict":
+            return _apply_evict(supervisor, decision, report=report,
+                                state=state, epoch=epoch, step=step)
+        if decision.action == "retune":
+            return _apply_retune(supervisor, decision, report=report,
+                                 state=state, epoch=epoch, step=step,
+                                 gate=gate)
+    raise ValueError(
+        f"action {decision.action!r} is not applicable "
+        "(apply_decision commits 'evict' and 'retune'; 'detect'/'grow'/"
+        "'refuse' are observations — emit them directly)")
+
+
+def _refusal(decision: ControlDecision, reasons: List[str], *,
+             epoch: int, step: int, world: int) -> ControlDecision:
+    return emit_decision(ControlDecision(
+        action="refuse",
+        reason=f"{decision.action} refused: {reasons[0] if reasons else ''}",
+        rank=decision.rank, gen=decision.gen, epoch=epoch, step=step,
+        world_from=world, world_to=world, applied=False,
+        evidence={"refused_action": decision.action,
+                  "refusals": list(reasons),
+                  **decision.evidence}))
+
+
+def _apply_evict(supervisor, decision: ControlDecision, *, report, state,
+                 epoch: int, step: int) -> Tuple[Any, ControlDecision]:
+    world_from = supervisor.world_size
+    # the canonical tag, not the free-text reason: the resize record's
+    # `cause` is what the chaos verdict (and any dashboard) matches on
+    state, applied, detail = supervisor.boundary_shrink(
+        report, state, epoch=epoch, step=step,
+        evicted_rank=decision.rank, cause="straggler_evict")
+    if not applied:
+        return state, _refusal(decision, [detail], epoch=epoch, step=step,
+                               world=world_from)
+    final = emit_decision(dataclasses.replace(
+        decision, epoch=epoch, step=step, world_from=world_from,
+        world_to=supervisor.world_size, applied=True))
+    return state, final
+
+
+def _apply_retune(supervisor, decision: ControlDecision, *, report, state,
+                  epoch: int, step: int, gate) -> Tuple[Any, ControlDecision]:
+    world = supervisor.world_size
+    overrides = dict(decision.evidence.get("overrides", {}))
+    if not overrides:
+        return state, _refusal(decision, ["no overrides proposed"],
+                               epoch=epoch, step=step, world=world)
+    ok, refusals = gate(overrides)
+    if not ok:
+        return state, _refusal(decision, refusals, epoch=epoch, step=step,
+                               world=world)
+    state, applied, detail = supervisor.boundary_retune(
+        report, state, epoch=epoch, step=step, overrides=overrides,
+        cause=decision.reason)
+    if not applied:
+        return state, _refusal(decision, [detail], epoch=epoch, step=step,
+                               world=world)
+    final = emit_decision(dataclasses.replace(
+        decision, epoch=epoch, step=step, world_from=world,
+        world_to=supervisor.world_size, applied=True))
+    return state, final

@@ -1,0 +1,247 @@
+"""The autopilot: observability plane in, gated decisions out.
+
+One object closes the loop. It rides the telemetry recorder as an
+OBSERVER (`Recorder.add_observer` — called outside the stream lock,
+forbidden to emit; it only buffers), and the Supervisor calls
+:meth:`Autopilot.on_segment_boundary` from its clean-segment path — the
+same anchor elastic resizes use — so every decision lands where the run
+is drained, checkpoint-anchored, and safe to re-plan.
+
+Loop (1), straggler eviction: buffered ``data_wait``/``step_dispatch``
+spans feed `telemetry.aggregate.detect_stragglers` at each boundary;
+the rows feed a :class:`~.straggler.StragglerEvictionPolicy`; a verdict
+(same rank, N consecutive flagged steps) emits a ``detect`` decision
+and hands an ``evict`` to `control.apply_decision` — shrink via the
+elastic path. While evicted capacity is out, detection is suspended (a
+shrunken fleet re-convicting itself would thrash); when the Supervisor's
+own boundary grow re-admits the capacity, the autopilot observes the
+world change and emits the accounting ``grow`` decision, completing the
+detect -> evict -> grow chain the chaos verdict reads back.
+
+Loop (2), online tuning: ``device_profile`` windows (watchdog-armed
+captures) feed a :class:`~.tuner.PerfTuner`; a proposal becomes a
+``retune`` decision that `apply_decision` contract-gates before the
+Supervisor applies it at this same boundary — or refuses with a logged
+decision, and the run continues on the old config.
+
+Identity hygiene: ANY world change (an eviction, a failure re-plan, a
+grow) clears the policy's history and the span buffer — rank labels
+renumber across resizes, and stale history must not convict whichever
+new rank inherited a number (`StragglerEvictionPolicy.note_resize`).
+
+Off by default, nothing when off: no Autopilot object, no observer, no
+threads, no new events — the recorder stream and the lowered HLO are
+byte-identical to a build without this package (the PR 8/13/14
+discipline, pinned by tests/test_control.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry as _telemetry
+from ..telemetry.aggregate import StreamSegment, detect_stragglers
+from ..telemetry.device import DEVICE_PROFILE_KIND
+from .apply import apply_decision, contract_gate
+from .decisions import ControlDecision, emit_decision
+from .straggler import StragglerEvictionPolicy
+from .tuner import PerfTuner
+
+# Span-buffer bound: boundaries drain it on every resize and detection
+# re-runs over the whole window, so this only guards a pathological
+# never-resizing run from unbounded growth. 4096 events is hours of
+# CPU-mesh steps.
+MAX_BUFFERED_EVENTS = 4096
+
+
+class Autopilot:
+    """The control loop the Supervisor consults at segment boundaries.
+
+    ``policy=None`` disables eviction, ``tuner=None`` disables retuning;
+    the default is eviction-only (the chaos-proven loop). ``gate``
+    defaults to the real contract gate; tests inject stubs to exercise
+    the refusal path without lowering HLO.
+    """
+
+    def __init__(self, policy: Optional[StragglerEvictionPolicy] = None,
+                 tuner: Optional[PerfTuner] = None, *,
+                 evict: bool = True,
+                 rel_factor: float = 5.0, abs_floor_s: float = 0.25,
+                 gate=contract_gate):
+        self.policy = (policy if policy is not None
+                       else (StragglerEvictionPolicy() if evict else None))
+        self.tuner = tuner
+        self.rel_factor = float(rel_factor)
+        self.abs_floor_s = float(abs_floor_s)
+        self.gate = gate
+        self.decisions: List[ControlDecision] = []
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._attached_to = None
+        self._last_world: Optional[int] = None
+        # world to watch for while evicted capacity is out (the pre-shrink
+        # world); non-None suspends detection
+        self._pending_readmit: Optional[int] = None
+        self._evicted_rank: Optional[int] = None
+
+    # -- recorder plumbing --------------------------------------------------
+
+    def attach(self) -> "Autopilot":
+        """Register the buffering observer on the configured recorder.
+        Raises when telemetry is unconfigured: an autopilot without a
+        stream would decide blind AND leave no audit trail."""
+        rec = _telemetry.get()
+        if rec is None:
+            raise RuntimeError(
+                "autopilot requires configured telemetry "
+                "(telemetry.configure(...) / --telemetry-dir): its inputs "
+                "and its decision log are both the stream")
+        rec.add_observer(self._observe)
+        self._attached_to = rec
+        return self
+
+    def detach(self) -> None:
+        if self._attached_to is not None:
+            try:
+                self._attached_to.remove_observer(self._observe)
+            finally:
+                self._attached_to = None
+
+    def _observe(self, ev: dict) -> None:
+        # Recorder-observer contract: NEVER emit from here. Buffer the
+        # straggler phases and feed profile windows to the tuner; drop
+        # everything else on the floor.
+        kind = ev.get("kind")
+        interesting = (
+            kind == DEVICE_PROFILE_KIND
+            or (kind == "span" and self.policy is not None
+                and ev.get("name") in self.policy.phases))
+        if not interesting:
+            return
+        with self._lock:
+            if kind == DEVICE_PROFILE_KIND and self.tuner is not None:
+                self.tuner.observe(ev)
+            if len(self._events) < MAX_BUFFERED_EVENTS:
+                self._events.append(ev)
+
+    def _drain(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def _clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # -- the boundary hook --------------------------------------------------
+
+    def on_segment_boundary(self, *, supervisor, report, state,
+                            epoch: int, step: int):
+        """Called by the Supervisor at each clean segment boundary (its
+        thread, never an observer's). Returns the (possibly resharded)
+        state."""
+        world = int(supervisor.world_size)
+        if self._last_world is not None and world != self._last_world:
+            # any resize — ours, a failure re-plan, a grow — remaps rank
+            # identity: forget everything measured under the old numbering
+            if self.policy is not None:
+                self.policy.note_resize()
+            self._clear()
+            if (self._pending_readmit is not None
+                    and world >= self._pending_readmit):
+                self.decisions.append(emit_decision(ControlDecision(
+                    action="grow",
+                    reason="capacity returned; evicted share re-admitted "
+                           "by the boundary grow",
+                    rank=self._evicted_rank, epoch=epoch, step=step,
+                    world_from=self._last_world, world_to=world,
+                    applied=True)))
+                self._pending_readmit = None
+                self._evicted_rank = None
+        self._last_world = world
+
+        if self.policy is not None and self._pending_readmit is None:
+            state = self._run_eviction(supervisor, report, state,
+                                       epoch=epoch, step=step)
+        if self.tuner is not None:
+            state = self._run_tuner(supervisor, report, state,
+                                    epoch=epoch, step=step)
+        return state
+
+    # -- loops --------------------------------------------------------------
+
+    def _segment(self, events: List[dict]) -> StreamSegment:
+        gen = int(events[0].get("gen", 0)) if events else 0
+        rank = int(events[0].get("rank", 0)) if events else 0
+        return StreamSegment(gen=gen, rank=rank, path="<live>",
+                             anchor_ts=float(events[0].get("ts", 0.0))
+                             if events else 0.0,
+                             events=list(events))
+
+    def _run_eviction(self, supervisor, report, state, *, epoch, step):
+        events = self._drain()
+        if not events:
+            return state
+        rows = detect_stragglers([self._segment(events)],
+                                 phases=self.policy.phases,
+                                 rel_factor=self.rel_factor,
+                                 abs_floor_s=self.abs_floor_s)
+        self.policy.observe_rows(rows)
+        verdict = self.policy.verdict()
+        if verdict is None:
+            return state
+        self.decisions.append(emit_decision(ControlDecision(
+            action="detect",
+            reason=(f"rank {verdict['rank']} persistently slow: "
+                    f"{len(verdict['steps'])} consecutive flagged steps"),
+            rank=verdict["rank"], gen=verdict["gen"], epoch=epoch,
+            step=step, world_from=supervisor.world_size,
+            evidence={"steps": verdict["steps"],
+                      "worst": verdict["evidence"]})))
+        evict = ControlDecision(
+            action="evict",
+            reason=(f"straggler_evict: rank {verdict['rank']} flagged at "
+                    f"steps {verdict['steps']}"),
+            rank=verdict["rank"], gen=verdict["gen"],
+            evidence={"steps": verdict["steps"],
+                      "worst": verdict["evidence"]})
+        world_before = int(supervisor.world_size)
+        state, final = apply_decision(supervisor, evict, report=report,
+                                      state=state, epoch=epoch, step=step,
+                                      gate=self.gate)
+        self.decisions.append(final)
+        if final.applied:
+            self._pending_readmit = world_before
+            self._evicted_rank = verdict["rank"]
+            self.policy.note_resize()
+            self._clear()
+            self._last_world = int(supervisor.world_size)
+        return state
+
+    def _current_config(self, supervisor) -> Dict[str, Any]:
+        cfg = getattr(getattr(supervisor, "trainer", None), "config", None)
+        out: Dict[str, Any] = {}
+        for key in ("wire_dtype", "bucket_cap_mb", "overlap_grad_sync",
+                    "grad_accum"):
+            val = getattr(cfg, key, None)
+            if val is not None:
+                out[key] = val
+        return out
+
+    def _run_tuner(self, supervisor, report, state, *, epoch, step):
+        proposal = self.tuner.propose(self._current_config(supervisor))
+        if proposal is None:
+            return state
+        retune = ControlDecision(
+            action="retune",
+            reason=("exposed-comm ratio "
+                    f"{proposal['evidence']['mean_exposed_comm_ratio']} over "
+                    f"{proposal['evidence']['windows']} windows >= "
+                    f"{proposal['evidence']['threshold']}"),
+            evidence={"overrides": proposal["overrides"],
+                      **proposal["evidence"]})
+        state, final = apply_decision(supervisor, retune, report=report,
+                                      state=state, epoch=epoch, step=step,
+                                      gate=self.gate)
+        self.decisions.append(final)
+        return state

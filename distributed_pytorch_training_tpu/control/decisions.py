@@ -1,0 +1,91 @@
+"""The one typed record every control action flows through.
+
+A :class:`ControlDecision` is the policy layer's unit of accountability:
+whatever a loop decides — naming a straggler, evicting it, re-admitting
+returned capacity, re-planning the wire config, or REFUSING a candidate
+that failed its contract — the decision is emitted as one
+``control_decision`` telemetry event (kind
+:data:`~..telemetry.recorder.CONTROL_DECISION_KIND`, name = the action)
+on the same stream as every other instrument. ``telemetry summary``
+renders the chain, ``/metrics`` counts it as
+``dpt_control_decisions_total{action}``, and the chaos autopilot verdict
+reads it back — a control plane whose actions were not in the stream
+would be indistinguishable from a flaky fleet.
+
+Actions:
+
+* ``detect`` — a policy named a persistently slow rank (informational;
+  always precedes an evict).
+* ``evict`` — the straggler is treated as a capacity loss: drain the
+  segment, shrink via the elastic re-plan path.
+* ``grow`` — previously evicted/preempted capacity was re-admitted (the
+  Supervisor's boundary grow, observed and accounted by the autopilot).
+* ``retune`` — the online tuner re-planned the training config at a
+  segment boundary (only after its contract passed).
+* ``refuse`` — a candidate action was rejected: contract findings, a
+  config the matrix cannot even lower, or a re-plan surface that
+  declined (shrink below the smallest viable world, unanchored
+  checkpoint). Refusals are decisions too — a tuner that silently
+  dropped a failing candidate would leave no audit trail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .. import telemetry as _telemetry
+from ..telemetry.recorder import CONTROL_DECISION_KIND  # noqa: F401  (re-export)
+
+DECISION_ACTIONS = ("detect", "evict", "grow", "retune", "refuse")
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlDecision:
+    """One gated control action: what, about whom, why, and whether it
+    was actually applied. ``evidence`` carries the measurement that
+    justified it (straggler rows, exposed-comm ratios, contract
+    findings) — flattened into the telemetry event so the stream is the
+    audit trail, not a pointer to one."""
+
+    action: str
+    reason: str
+    rank: Optional[int] = None
+    gen: Optional[int] = None
+    epoch: Optional[int] = None
+    step: Optional[int] = None
+    world_from: Optional[int] = None
+    world_to: Optional[int] = None
+    applied: bool = False
+    evidence: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.action not in DECISION_ACTIONS:
+            raise ValueError(f"unknown control action {self.action!r} "
+                             f"(choose from {DECISION_ACTIONS})")
+
+    def fields(self) -> Dict[str, Any]:
+        """The telemetry-event payload: every non-None scalar field plus
+        the evidence dict, JSON-ready."""
+        out: Dict[str, Any] = {"action": self.action, "reason": self.reason,
+                               "applied": bool(self.applied)}
+        for key in ("rank", "gen", "epoch", "step", "world_from",
+                    "world_to"):
+            val = getattr(self, key)
+            if val is not None:
+                out[key] = int(val)
+        if self.evidence:
+            out["evidence"] = dict(self.evidence)
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return self.fields()
+
+
+def emit_decision(decision: ControlDecision) -> ControlDecision:
+    """Put one decision on the telemetry stream (no-op when telemetry is
+    unconfigured, like every module-level emit helper) and return it —
+    callers chain ``decisions.append(emit_decision(d))``."""
+    _telemetry.emit(CONTROL_DECISION_KIND, decision.action,
+                    **decision.fields())
+    return decision

@@ -1,0 +1,106 @@
+"""Straggler-eviction policy: persistence, not a single bad step.
+
+`telemetry.aggregate.detect_stragglers` names (gen, rank, step, phase)
+outliers; this policy answers the only question the control plane may
+act on: is the SAME rank persistently slow — flagged at
+``n_consecutive`` consecutive step labels — so that treating it as a
+capacity loss (drain -> shrink -> re-admit on recovery) beats waiting it
+out? One flagged step is weather (a GC pause, a cold page); N in a row
+is a sick host.
+
+Identity discipline: rank labels are only meaningful WITHIN one world
+layout. After any elastic resize the surviving ranks renumber, so
+:meth:`StragglerEvictionPolicy.note_resize` drops ALL accumulated
+history — an old slow rank's record must never convict whichever new
+rank inherited its number (the ISSUE 20 persistence-across-resize
+satellite pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry.aggregate import STRAGGLER_PHASES
+
+# Consecutive flagged steps before a rank is named for eviction. 3 is the
+# floor at which "persistent" is distinguishable from "unlucky twice" on
+# the CPU-mesh step times the detector's abs floor already filters.
+DEFAULT_N_CONSECUTIVE = 3
+
+
+class StragglerEvictionPolicy:
+    """Accumulate detector rows; convict on N consecutive flagged steps.
+
+    ``observe_rows`` is idempotent per (gen, rank, step): the autopilot
+    re-runs the detector over its whole buffered window at every segment
+    boundary, so the same flag arriving twice must not double-count.
+    ``verdict`` returns the worst persistent rank (longest flagged run,
+    then highest factor) or None while nothing crosses the threshold.
+    """
+
+    def __init__(self, n_consecutive: int = DEFAULT_N_CONSECUTIVE,
+                 phases: Tuple[str, ...] = STRAGGLER_PHASES):
+        if n_consecutive < 1:
+            raise ValueError("n_consecutive must be >= 1")
+        self.n_consecutive = int(n_consecutive)
+        self.phases = tuple(phases)
+        # (gen, rank) -> {step -> worst row seen for that step}
+        self._flags: Dict[Tuple[int, int], Dict[int, dict]] = {}
+
+    def observe_rows(self, rows: List[dict]) -> None:
+        """Merge one detector pass. Rows outside the configured phases
+        are ignored (an eval-span outlier is not a training straggler)."""
+        for row in rows:
+            if row.get("phase") not in self.phases:
+                continue
+            key = (int(row.get("gen", 0)), int(row.get("rank", 0)))
+            steps = self._flags.setdefault(key, {})
+            step = int(row["step"])
+            prev = steps.get(step)
+            if prev is None or row.get("dur_s", 0.0) > prev.get("dur_s", 0.0):
+                steps[step] = dict(row)
+
+    def note_resize(self) -> None:
+        """Rank identities just remapped (any elastic resize, either
+        direction): forget everything. History from the old numbering
+        must not convict a new rank."""
+        self._flags.clear()
+
+    def flagged_steps(self, gen: int, rank: int) -> List[int]:
+        return sorted(self._flags.get((int(gen), int(rank)), ()))
+
+    def verdict(self) -> Optional[dict]:
+        """The persistent straggler, if any: ``{"gen", "rank", "steps",
+        "evidence"}`` where ``steps`` is the qualifying consecutive run
+        (>= n_consecutive) and ``evidence`` the worst row of that run
+        (detector fields, device attribution when a capture covered
+        it)."""
+        best: Optional[dict] = None
+        for (gen, rank), steps in self._flags.items():
+            run = _longest_consecutive_run(sorted(steps))
+            if len(run) < self.n_consecutive:
+                continue
+            worst = max((steps[s] for s in run),
+                        key=lambda r: r.get("dur_s", 0.0))
+            candidate = {"gen": gen, "rank": rank, "steps": run,
+                         "evidence": worst}
+            if best is None or (len(run), worst.get("factor", 0.0)) > (
+                    len(best["steps"]), best["evidence"].get("factor", 0.0)):
+                best = candidate
+        return best
+
+
+def _longest_consecutive_run(steps: List[int]) -> List[int]:
+    """Longest run of consecutive integers in an ascending list (ties:
+    the earliest run — the first sustained stall is the one that
+    convicts)."""
+    best: List[int] = []
+    run: List[int] = []
+    for s in steps:
+        if run and s == run[-1] + 1:
+            run.append(s)
+        else:
+            run = [s]
+        if len(run) > len(best):
+            best = list(run)
+    return best

@@ -1,0 +1,123 @@
+"""Online perf tuner: exposed-comm ratio -> a candidate wire re-plan.
+
+The device-attribution plane (telemetry/device.py) already measures the
+number that matters for the wire choice: ``exposed_comm_ratio`` — the
+fraction of collective time the step FAILED to hide behind compute —
+captured in watchdog-armed windows and emitted as ``device_profile``
+events. This tuner closes the loop: accumulate the ratios, and when the
+fleet is persistently comm-exposed on an exact fp32 wire, propose the
+compressed-wire config (bucket cap + int8 multihop — the DynamiQ-style
+choice PAPERS.md frames as the slow-interconnect remedy).
+
+The tuner only PROPOSES. Nothing here touches the re-plan surface:
+`control.apply_decision` runs the candidate through the ``analysis/``
+contract matrix first (the ``control_replan`` contract with the
+overrides applied) and refuses — with a logged decision — any candidate
+that fails or cannot even lower. Applied re-plans land ONLY at segment
+boundaries via ``Supervisor.boundary_retune``, anchored on a durable
+checkpoint exactly like an elastic resize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..telemetry.device import DEVICE_PROFILE_KIND
+
+# Config keys a tuner candidate may override — the knobs the contract
+# matrix actually checks. Anything else in an overrides dict is refused
+# by the gate before it can reach a TrainConfig.
+TUNABLE_KEYS = ("wire_dtype", "bucket_cap_mb", "overlap_grad_sync",
+                "grad_accum")
+
+# Default compressed-wire candidate: the bucketed DynamiQ multihop form
+# the gsync_int8_mh contract pins. The tiny bucket cap mirrors the
+# contract matrix's _CAP so the candidate engages multi-bucket behavior
+# even on the contract model.
+DEFAULT_CANDIDATE: Dict[str, Any] = {"wire_dtype": "int8_multihop",
+                                     "bucket_cap_mb": 0.02}
+
+
+class PerfTuner:
+    """Accumulate ``device_profile`` windows; propose one re-plan.
+
+    ``threshold`` is the mean exposed-comm ratio above which the fp32
+    wire is judged interconnect-bound; ``min_windows`` is the number of
+    captured windows required before the mean is credible (one window is
+    weather). The tuner is one-shot by design: after a proposal —
+    whether the gate applied or refused it — it stays quiet until
+    :meth:`reset`, because re-proposing the same refused candidate every
+    boundary would spam the decision log without new evidence.
+    """
+
+    def __init__(self, threshold: float = 0.3, min_windows: int = 2,
+                 candidate: Optional[Dict[str, Any]] = None):
+        if not 0.0 <= threshold <= 1.0:
+            raise ValueError("threshold is a ratio in [0, 1]")
+        if min_windows < 1:
+            raise ValueError("min_windows must be >= 1")
+        self.threshold = float(threshold)
+        self.min_windows = int(min_windows)
+        self.candidate = dict(candidate if candidate is not None
+                              else DEFAULT_CANDIDATE)
+        unknown = [k for k in self.candidate if k not in TUNABLE_KEYS]
+        if unknown:
+            raise ValueError(f"candidate overrides {unknown} are not "
+                             f"tunable (knobs: {TUNABLE_KEYS})")
+        self._ratios: List[float] = []
+        self._proposed = False
+
+    def observe(self, ev: Dict[str, Any]) -> None:
+        """Feed one telemetry event; only ``device_profile`` events with
+        an ``exposed_comm_ratio`` field count. Safe to call with the
+        whole stream."""
+        if ev.get("kind") != DEVICE_PROFILE_KIND:
+            return
+        ratio = ev.get("exposed_comm_ratio")
+        if ratio is None:
+            return
+        self._ratios.append(float(ratio))
+
+    @property
+    def windows(self) -> int:
+        return len(self._ratios)
+
+    def mean_ratio(self) -> Optional[float]:
+        if not self._ratios:
+            return None
+        return sum(self._ratios) / len(self._ratios)
+
+    def propose(self, current_config: Optional[Dict[str, Any]] = None
+                ) -> Optional[Dict[str, Any]]:
+        """The candidate overrides, or None.
+
+        Proposes iff: not already proposed, >= min_windows captured,
+        mean ratio >= threshold, and the current wire (from
+        ``current_config``, default exact fp32) is not already the
+        candidate's. Returns ``{"overrides": ..., "evidence": ...}`` —
+        the evidence rides the decision record verbatim."""
+        if self._proposed or len(self._ratios) < self.min_windows:
+            return None
+        mean = self.mean_ratio()
+        if mean is None or mean < self.threshold:
+            return None
+        current = dict(current_config or {})
+        if current.get("wire_dtype", "fp32") == self.candidate.get(
+                "wire_dtype", "fp32"):
+            return None  # already on the proposed wire
+        self._proposed = True
+        return {
+            "overrides": dict(self.candidate),
+            "evidence": {
+                "mean_exposed_comm_ratio": round(mean, 4),
+                "windows": len(self._ratios),
+                "threshold": self.threshold,
+                "current_wire": current.get("wire_dtype", "fp32"),
+            },
+        }
+
+    def reset(self) -> None:
+        """Re-arm (new config epoch: a retune landed or was refused and
+        the operator changed the candidate)."""
+        self._ratios.clear()
+        self._proposed = False

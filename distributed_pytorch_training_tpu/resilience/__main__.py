@@ -96,6 +96,26 @@ def check_flights(flight_dir, fired: List[str],
             "flights_ok": ok}
 
 
+def read_control_decisions(stream_path) -> List[dict]:
+    """The control-plane audit trail, read BACK from the stream JSONL —
+    the autopilot verdict must prove the decisions were RECORDED (the
+    operator-facing artifact), not merely taken in memory."""
+    from ..telemetry.recorder import CONTROL_DECISION_KIND
+
+    out: List[dict] = []
+    path = Path(stream_path)
+    if not path.exists():
+        return out
+    for line in path.read_text().splitlines():
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            continue
+        if ev.get("kind") == CONTROL_DECISION_KIND:
+            out.append(ev)
+    return out
+
+
 def _build_rig(mesh, seed: int, dataset_size: int, per_device_batch: int,
                fault_hook=None, layout: str = "replicated",
                wire_dtype: str = "fp32"):
@@ -247,6 +267,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "capacity_return fault grows it back at the next "
                         "segment boundary, and the parity control "
                         "verifies the post-resize segment bitwise")
+    p.add_argument("--autopilot", action="store_true",
+                   help="close the control loop (ISSUE 20): attach the "
+                        "control/ Autopilot to the telemetry stream and "
+                        "let it evict a persistently slow rank at a "
+                        "segment boundary (shrink via the elastic path; "
+                        "implies --elastic). The default schedule stalls "
+                        "the loader 3 consecutive steps on the same rank "
+                        "and returns the capacity later — the verdict "
+                        "requires the full detect -> evict -> grow "
+                        "decision chain on the stream plus bitwise "
+                        "post-resize parity")
     p.add_argument("--layout", default="replicated",
                    choices=["replicated", "zero1", "fsdp"],
                    help="state layout the run (and any reshard) exercises")
@@ -257,7 +288,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="training epochs (default: 2 for chaos; 3 for "
                         "fleet — one epoch per world phase)")
     p.add_argument("--per-device-batch", type=int, default=2)
-    p.add_argument("--dataset-size", type=int, default=64)
+    p.add_argument("--dataset-size", type=int, default=None,
+                   help="synthetic dataset rows (default 64; 128 with "
+                        "--autopilot — the eviction needs enough steps "
+                        "per epoch for a 3-stall run plus the boundary "
+                        "that convicts it)")
     p.add_argument("--checkpoint-every-steps", type=int, default=2)
     p.add_argument("--max-restarts", type=int, default=8)
     p.add_argument("--ckpt-dir", default=None,
@@ -276,6 +311,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         return fleet_main(args)
     if args.epochs is None:
         args.epochs = 2
+    if args.autopilot:
+        # the autopilot rides the elastic surface: eviction IS a shrink
+        # re-plan, re-admission IS the boundary grow
+        args.elastic = True
+    if args.dataset_size is None:
+        args.dataset_size = 128 if args.autopilot else 64
+    if args.chaos is None and args.autopilot:
+        # loop (1)'s proof schedule: the SAME rank stalls three
+        # consecutive in-epoch steps (the policy's N) — no fault raises,
+        # nothing crashes; the ONLY path to a resize is the autopilot
+        # naming the straggler from data_wait spans and evicting it at
+        # the boundary after the third stall. The capacity then returns
+        # (absolute step 11, inside the shrunken world's epoch 1) and the
+        # ordinary boundary grow re-admits it — detect -> evict -> grow.
+        args.chaos = ("loader_stall@step=5:0.9s,loader_stall@step=6:0.9s,"
+                      "loader_stall@step=7:0.9s,capacity_return@step=11")
     if args.chaos is None:
         # the default elastic schedule is BIDIRECTIONAL (ISSUE 12): kill
         # a replica at step 3 (8 -> 4 at the restart), return the
@@ -378,11 +429,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             return ElasticPlan(trainer=t, loader=ld, state_factory=sf,
                                world=world)
 
+    autopilot = None
+    if args.autopilot:
+        # ISSUE 20: the policy layer rides the recorder as an observer
+        # and is consulted by the Supervisor at clean segment boundaries;
+        # nothing below this block exists when --autopilot is off.
+        from ..control import Autopilot
+        autopilot = Autopilot().attach()
     sup = Supervisor(trainer, ckpt, state_factory, loader, retry=retry,
                      guard=guard, injector=injector,
                      checkpoint_every_steps=args.checkpoint_every_steps,
                      resume_preempted=True, replan_cb=replan_cb,
-                     capacity_watch=capacity)
+                     capacity_watch=capacity, control=autopilot)
     error = None
     try:
         state, report = sup.run(args.epochs)
@@ -392,9 +450,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         guard.reset()
         ckpt.close()
+        if autopilot is not None:
+            autopilot.detach()
         telemetry.reset()  # close the JSONL; flights are already on disk
     flight_stats = check_flights(ckpt_dir, report.faults_fired,
                                  ignore=pre_existing_flights)
+    decisions = (read_control_decisions(
+        Path(ckpt_dir) / "telemetry_rank0.jsonl")
+        if args.autopilot else [])
 
     parity = None
     if state is not None and not args.no_verify_parity:
@@ -429,6 +492,13 @@ def main(argv: Optional[List[str]] = None) -> int:
              "epochs": args.epochs, "ckpt_dir": ckpt_dir,
              "elastic": args.elastic, "layout": args.layout,
              "wire_dtype": args.wire_dtype,
+             "autopilot": args.autopilot,
+             "control_decisions": [
+                 {("action" if k == "name" else k): d.get(k)
+                  for k in ("name", "rank", "epoch", "step", "world_from",
+                            "world_to", "applied", "reason")
+                  if d.get(k) is not None}
+                 for d in decisions],
              "parity_bitwise": parity, "error": error,
              # the async-save instrument: loop-blocked ms vs snapshot ms
              "save_blocked_ms": round(ckpt.save_blocked_ms, 1),
@@ -447,11 +517,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the grow requirement binds only under --elastic: without a watch a
     # capacity_return fault fires into the void by design (faults.py) —
     # a fixed-world run that recovered must not be scored FAILED for it
+    # the autopilot bar (ISSUE 20): the shrink must be the CONTROL
+    # PLANE's doing (a resize whose cause is straggler_evict — no fault
+    # raised in this schedule), and the full decision chain must be
+    # readable back off the stream: a detect, an APPLIED evict, and the
+    # accounting grow once capacity returned
+    actions = [d.get("name") for d in decisions]
+    evicted = any(r.get("cause") == "straggler_evict"
+                  and r.get("direction") == "shrink"
+                  for r in report.resizes)
+    chain_ok = (not args.autopilot
+                or (evicted and "detect" in actions and "grow" in actions
+                    and any(d.get("name") == "evict" and d.get("applied")
+                            for d in decisions)))
     ok = (report.completed and report.fence_violations == 0
           and parity is not False and error is None
           and flight_stats["flights_ok"]
           and (not args.elastic or bool(report.resizes))
-          and (not args.elastic or not capacity_returned or grew))
+          and (not args.elastic or not capacity_returned or grew)
+          and chain_ok)
     if args.as_json:
         print(json.dumps(stats, sort_keys=True))
     else:
@@ -466,6 +550,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"(available={r['survivors']}, anchor label "
                   f"{r['label']}, resumed epoch {r['epoch']} "
                   f"step {r['step']})")
+        for d in stats["control_decisions"]:
+            who = (f" rank {d['rank']}" if d.get("rank") is not None
+                   else "")
+            world = (f" world {d['world_from']}->{d['world_to']}"
+                     if d.get("world_to") is not None else "")
+            applied = " [applied]" if d.get("applied") else ""
+            print(f"control {d['action']}:{who}{world}{applied} "
+                  f"{d.get('reason', '')}")
         print(f"flight artifacts: {len(stats['flights'])} "
               f"(ok={stats['flights_ok']}"
               + (f", missing={stats['flights_missing']}"

@@ -276,3 +276,46 @@ def reshard_train_state(state, old_n: int, new_n: int, trainer,
         step=_place_leaf(jax.device_get(state.step), template.step),
         params=new_params, opt_state=new_opt, batch_stats=new_stats,
         grad_sync=new_gs)
+
+
+def adopt_state(state, template):
+    """Carry a live TrainState into a SAME-WORLD template built under a
+    different training config (the control plane's segment-boundary
+    retune, ISSUE 20).
+
+    Per leaf path: when the template has a leaf of identical shape and
+    dtype at the same path, the live value is carried — placed into the
+    template leaf's sharding, bit-for-bit (params, optimizer moments,
+    batch stats, the step counter: a config re-plan must not move the
+    trajectory). Leaves the new config re-shapes or introduces (a wire
+    change swaps the error-feedback residual layout; fp32 -> compressed
+    grows one) take the template's FRESH value — exactly the state a
+    same-config restart from the boundary checkpoint would start with,
+    which is the retune's stated exactness model (PARITY.md "Control
+    decisions never change numerics").
+
+    Returns ``(new_state, resets)`` where ``resets`` names the leaf
+    paths that took the template's value — the retune decision records
+    them, so a reset EF buffer is an audit-trail fact, not a surprise.
+    """
+    import jax
+
+    old_leaves = {
+        jax.tree_util.keystr(path): leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state)}
+    resets = []
+
+    def pick(path, tmpl_leaf):
+        key = jax.tree_util.keystr(path)
+        old = old_leaves.get(key)
+        if (old is not None
+                and getattr(old, "shape", None) == getattr(tmpl_leaf,
+                                                           "shape", None)
+                and getattr(old, "dtype", None) == getattr(tmpl_leaf,
+                                                           "dtype", None)):
+            return _place_leaf(jax.device_get(old), tmpl_leaf)
+        resets.append(key)
+        return tmpl_leaf
+
+    new_state = jax.tree_util.tree_map_with_path(pick, template)
+    return new_state, resets

@@ -68,6 +68,14 @@ __all__ = ["FLEET_GENERATION_ENV", "FLEET_RANK_ENV", "FleetOrchestrator",
            "FleetLaunch", "FleetReport", "ReplicaProc", "ServingFleet",
            "checkpoint_progress", "check_fleet_flights", "fleet_main"]
 
+# runtime/dist.py's multi-host rendezvous contract (setup_distributed):
+# the orchestrator is the WRITER of these stamps, the child's
+# jax.distributed.initialize the reader — one generation spanning
+# `hosts` processes rendezvouses through them (ISSUE 20).
+DIST_COORD_ENV = "DPT_COORDINATOR_ADDRESS"
+DIST_NPROC_ENV = "DPT_NUM_PROCESSES"
+DIST_PROC_ID_ENV = "DPT_PROCESS_ID"
+
 _DEVICE_COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
@@ -119,6 +127,10 @@ class FleetLaunch:
     available: int
     resume: bool
     argv: List[str] = dataclasses.field(default_factory=list)
+    # multi-host generations (ISSUE 20): exit codes of ranks 1..hosts-1
+    # (rank 0's rc stays in `rc` — it is the generation's verdict; any
+    # non-zero peer marks the generation crashed)
+    peer_rcs: List[int] = dataclasses.field(default_factory=list)
     rc: Optional[int] = None
     seconds: float = 0.0
     outcome: str = "launched"   # completed | drained | crashed | relay_death
@@ -187,6 +199,20 @@ class FleetOrchestrator:
     exited generations' last pages kept in the merge marked down. The
     final merged page lands in ``self.federation_page`` after
     :meth:`run`.
+
+    Multi-host generations (ISSUE 20): ``hosts > 1`` makes one
+    generation span ``hosts`` processes. The orchestrator stamps the
+    ``runtime.setup_distributed`` rendezvous contract into every child's
+    env — ``DPT_COORDINATOR_ADDRESS`` (``127.0.0.1:coordinator_port +
+    generation``, advancing per generation so a relaunch never races the
+    previous coordinator's socket), ``DPT_NUM_PROCESSES=hosts`` and a
+    per-child ``DPT_PROCESS_ID`` — launches ranks 1..hosts-1 alongside
+    rank 0, and gives each child ``world // hosts`` local devices. Rank
+    0 stays the watched child whose rc names the outcome; a non-zero
+    peer rc marks the generation ``crashed`` (the collective world was
+    torn) and a peer outliving rank 0 is killed after a grace window.
+    ``argv_for`` is then called with an extra ``rank`` kwarg, and the
+    federation proxy fans in over ``hosts`` per-rank metrics ports.
     """
 
     def __init__(self, argv_for: Callable[..., List[str]], ckpt_dir,
@@ -200,11 +226,20 @@ class FleetOrchestrator:
                  telemetry_dir=None,
                  metrics_port: Optional[int] = None,
                  federation_port: Optional[int] = None,
+                 hosts: int = 1,
+                 coordinator_port: Optional[int] = None,
                  progress_poll_s: float = 0.5,
                  log: Callable[[str], None] = _stderr_log):
         if max_launches < 1:
             raise ValueError(f"max_launches must be >= 1, "
                              f"got {max_launches}")
+        if hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {hosts}")
+        if hosts > 1 and coordinator_port is None:
+            raise ValueError(
+                "multi-host generations need a coordinator_port (the "
+                "DPT_COORDINATOR_ADDRESS rendezvous every child of a "
+                "generation initializes through)")
         self.argv_for = argv_for
         self.ckpt_dir = Path(ckpt_dir)
         self.global_batch = int(global_batch)
@@ -222,6 +257,12 @@ class FleetOrchestrator:
         self.metrics_port = metrics_port
         self.federation_port = federation_port
         self.federation_page: Optional[str] = None
+        # multi-host generations (ISSUE 20): one generation = `hosts`
+        # children rendezvousing via runtime.setup_distributed's env
+        # contract; argv_for is then called with a `rank` kwarg per child
+        self.hosts = int(hosts)
+        self.coordinator_port = (int(coordinator_port)
+                                 if coordinator_port is not None else None)
         self.progress_poll_s = float(progress_poll_s)
         self.log = log
 
@@ -248,9 +289,22 @@ class FleetOrchestrator:
             # base+rank here would offset twice — co-hosted ranks get
             # base+0, base+1, ... from one stamped value
             env[METRICS_PORT_ENV] = str(int(self.metrics_port))
+        local_world = world
+        if self.hosts > 1:
+            # one generation spans `hosts` processes: each child reads
+            # this rendezvous contract in runtime.setup_distributed()
+            # (jax.distributed.initialize) and owns world/hosts local
+            # devices. The coordinator port advances per generation —
+            # a relaunch must not race the previous coordinator's socket
+            # in TIME_WAIT.
+            env[DIST_COORD_ENV] = (
+                f"127.0.0.1:{self.coordinator_port + generation}")
+            env[DIST_NPROC_ENV] = str(self.hosts)
+            env[DIST_PROC_ID_ENV] = str(rank)
+            local_world = max(1, world // self.hosts)
         if self.set_child_devices:
             env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = _xla_flags_for(world,
+            env["XLA_FLAGS"] = _xla_flags_for(local_world,
                                               env.get("XLA_FLAGS", ""))
         return env
 
@@ -317,6 +371,58 @@ class FleetOrchestrator:
             launch.live_last_step = last_step_of(
                 follower.poll(), launch.live_last_step, gen=generation)
 
+    def _rank_argv(self, world: int, generation: int, resume: bool,
+                   rank: int) -> List[str]:
+        """One child's command line. Single-host keeps the historical
+        ``argv_for(world, generation, resume)`` contract untouched;
+        multi-host generations pass the child's rank so the builder can
+        address per-rank artifacts (stub tests, per-rank output dirs) —
+        topology itself rides the env, not the argv."""
+        if self.hosts == 1:
+            return list(self.argv_for(world=world, generation=generation,
+                                      resume=resume))
+        return list(self.argv_for(world=world, generation=generation,
+                                  resume=resume, rank=rank))
+
+    def _launch_peers(self, world: int, generation: int,
+                      resume: bool) -> List["subprocess.Popen"]:
+        peers: List["subprocess.Popen"] = []
+        try:
+            for rank in range(1, self.hosts):
+                p_log = self.log_dir / f"gen{generation}_rank{rank}.log"
+                lf = open(p_log, "wb")
+                try:
+                    peers.append(subprocess.Popen(
+                        self._rank_argv(world, generation, resume, rank),
+                        env=self._child_env(world, generation, rank=rank),
+                        stdout=lf, stderr=subprocess.STDOUT))
+                finally:
+                    lf.close()  # the child holds its own dup of the fd
+        except BaseException:
+            for p in peers:
+                p.kill()
+            for p in peers:
+                p.wait()
+            raise
+        return peers
+
+    def _wait_peers(self, peers: List["subprocess.Popen"],
+                    launch: FleetLaunch, report: FleetReport,
+                    generation: int, grace_s: float = 60.0) -> None:
+        """Collect ranks 1..hosts-1 after rank 0 exited. A peer outliving
+        rank 0 by the grace window is wedged (a torn rendezvous blocks in
+        a collective forever) — killed and recorded, never waited on
+        unboundedly."""
+        for rank, p in enumerate(peers, start=1):
+            try:
+                launch.peer_rcs.append(int(p.wait(timeout=grace_s)))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                launch.peer_rcs.append(int(p.wait()))
+                report.errors.append(
+                    f"generation {generation}: rank {rank} outlived "
+                    f"rank 0 by {grace_s:.0f}s and was killed")
+
     def run(self) -> FleetReport:
         report = FleetReport(target_step=self.target_step)
         self.log_dir.mkdir(parents=True, exist_ok=True)
@@ -327,8 +433,13 @@ class FleetOrchestrator:
             # background refresh faster than the child watch poll: a
             # short-lived generation must still land in the cache before
             # it exits (the final merged page carries every generation)
+            # one target per co-hosted rank: every child of a multi-host
+            # generation listens on base + its fleet rank, and the fan-in
+            # merges them all into one gen/rank-labelled page
             federation = FederationServer(
-                int(self.federation_port), targets=[int(self.metrics_port)],
+                int(self.federation_port),
+                targets=[int(self.metrics_port) + r
+                         for r in range(self.hosts)],
                 refresh_s=min(0.3, self.progress_poll_s))
             try:
                 port = federation.start()
@@ -355,8 +466,7 @@ class FleetOrchestrator:
             world = plan_elastic_world(available, self.global_batch)
             step_before, _ = checkpoint_progress(self.ckpt_dir)
             resume = step_before >= 0
-            argv = self.argv_for(world=world, generation=generation,
-                                 resume=resume)
+            argv = self._rank_argv(world, generation, resume, rank=0)
             launch = FleetLaunch(generation=generation, world=world,
                                  available=available, resume=resume,
                                  argv=list(argv))
@@ -364,27 +474,42 @@ class FleetOrchestrator:
             launch.log_path = str(log_path)
             self.log(f"fleet: generation {generation} — launching world "
                      f"{world} ({available} available"
+                     + (f", {self.hosts} host(s)" if self.hosts > 1
+                        else "")
                      + (", --resume" if resume else ", fresh") + ")")
             t0 = time.perf_counter()
+            peers: List["subprocess.Popen"] = []
             with open(log_path, "wb") as lf:
                 proc = subprocess.Popen(
                     argv, env=self._child_env(world, generation),
                     stdout=lf, stderr=subprocess.STDOUT)
                 try:
+                    # peers 1..hosts-1 of a multi-host generation launch
+                    # NOW: the whole generation rendezvouses through the
+                    # stamped coordinator before any child trains
+                    peers = self._launch_peers(world, generation, resume)
                     self._watch_child(proc, launch, generation)
+                    self._wait_peers(peers, launch, report, generation)
                 except BaseException:
                     # subprocess.run's contract, kept: Ctrl-C (or a
                     # raising watch callback) must not orphan a running
                     # training child — it would keep writing the shared
                     # checkpoint dir and holding the metrics port
-                    proc.kill()
-                    proc.wait()
+                    for p in [proc] + peers:
+                        p.kill()
+                    for p in [proc] + peers:
+                        p.wait()
                     raise
             launch.rc = proc.returncode
             launch.seconds = round(time.perf_counter() - t0, 3)
             step_after, world_after = checkpoint_progress(self.ckpt_dir)
             launch.step_after = step_after
             launch.outcome = self._outcome(launch.rc, step_after)
+            if launch.outcome in ("completed", "drained") \
+                    and any(rc != 0 for rc in launch.peer_rcs):
+                # rank 0 exiting clean does not absolve a dead peer: the
+                # generation's collective world was torn
+                launch.outcome = "crashed"
             try:
                 text = log_path.read_text(errors="replace")
             except OSError:

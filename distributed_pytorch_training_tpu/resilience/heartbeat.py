@@ -71,6 +71,20 @@ def port_listening(port: int, timeout: float = 0.2) -> bool:
         return False
 
 
+def registry_snapshot(ports: Optional[Sequence[int]] = None,
+                      timeout: float = 0.2) -> dict:
+    """One liveness sample of the whole relay registry: ``{port: up}``.
+
+    The control plane's capacity probe
+    (control/probe.py ``heartbeat_capacity_probe``) reads fleet capacity
+    off this snapshot — each registered port vouches for an equal share
+    of the fleet — and the autopilot's decision evidence embeds it, so
+    an eviction/grow decision records WHICH port was dark when it was
+    taken."""
+    return {int(p): port_listening(int(p), timeout=timeout)
+            for p in (ports if ports is not None else relay_ports())}
+
+
 def hard_exit(code: int) -> None:
     """The ONE sanctioned abrupt process exit (``os._exit``).
 

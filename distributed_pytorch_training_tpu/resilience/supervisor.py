@@ -99,6 +99,12 @@ class RunReport:
     # resumed, and direction is "shrink" (replica_death restart) or
     # "grow" (capacity-return boundary re-plan, ISSUE 12)
     resizes: List[dict] = dataclasses.field(default_factory=list)
+    # control-plane retunes (ISSUE 20): one record per applied
+    # segment-boundary config re-plan — {epoch, step, overrides, label,
+    # resets, cause} where `label` is the anchoring checkpoint and
+    # `resets` names the state leaves the new config's template replaced
+    # (wire-codec buffers; params/opt/step always carry over bitwise)
+    retunes: List[dict] = dataclasses.field(default_factory=list)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -160,6 +166,8 @@ class Supervisor:
                  deathwatch=None,
                  replan_cb: Optional[Callable[[int], Any]] = None,
                  capacity_watch=None,
+                 retune_cb: Optional[Callable[[dict], Any]] = None,
+                 control=None,
                  sleep: Callable[[float], None] = time.sleep):
         if checkpoint_every_steps is not None and checkpoint_every_steps <= 0:
             raise ValueError("checkpoint_every_steps must be positive "
@@ -192,6 +200,17 @@ class Supervisor:
         # feasible world, the LIVE state reshards M -> N in place and the
         # run continues — no restart, no replay, one `elastic_grow` span.
         self.capacity_watch = capacity_watch
+        # Control plane (ISSUE 20): ``retune_cb(overrides) -> ElasticPlan``
+        # rebuilds the rig at the SAME world under a new training config
+        # (the online tuner's apply path, `boundary_retune`), and
+        # ``control`` is a control.Autopilot-shaped object whose
+        # ``on_segment_boundary(supervisor=, report=, state=, epoch=,
+        # step=)`` is consulted at every clean segment boundary — the
+        # drained, checkpoint-anchored point where a decision may act.
+        # Both default off; the None path is byte-identical to a build
+        # without the control package.
+        self.retune_cb = retune_cb
+        self.control = control
         self.sleep = sleep
         # consecutive restore-and-replay attempts since the last CLEAN
         # segment — the RetryPolicy's budget/backoff index (resets to 0
@@ -209,6 +228,13 @@ class Supervisor:
         self._factories = ({self._world: state_factory}
                            if self._world is not None else {})
         self._last_restore_label: Optional[int] = None
+
+    @property
+    def world_size(self) -> int:
+        """Current data-parallel world (batch shards) — the number every
+        control decision records its from/to transition against. 1 when
+        the trainer exposes no shard count (single-device rigs)."""
+        return int(self._world) if self._world is not None else 1
 
     # -- fence / bookkeeping hooks ----------------------------------------
 
@@ -399,6 +425,145 @@ class Supervisor:
                  f"(live reshard, anchor checkpoint "
                  f"{self._last_saved_label}; sampler/RNG unchanged)")
         return state
+
+    # -- control-plane re-plan surface (ISSUE 20) --------------------------
+    #
+    # The two boundary methods below are the Supervisor's half of the
+    # control loop: policy lives in control/, but the elastic invariants
+    # (fixed global batch, steps-per-epoch, durable anchor before the rig
+    # swaps) live HERE, where every other resize already enforces them.
+    # Both return (state, applied, detail): a False apply is a refusal the
+    # caller logs as a decision — never an exception, because a declined
+    # control action must leave the run exactly as it was.
+
+    def boundary_shrink(self, report: RunReport, state, *, epoch: int,
+                        step: int, evicted_rank: Optional[int] = None,
+                        cause: str = ""):
+        """Evict one rank at a clean segment boundary: treat it as a
+        capacity loss of exactly one replica — re-plan to the largest
+        feasible smaller world, reshard the LIVE state (no restart, no
+        replay, the `_maybe_grow` mechanics in the shrink direction), and
+        debit the capacity watch so a later ``restore()`` re-admits the
+        share through the normal grow poll."""
+        if self.replan_cb is None:
+            return state, False, ("no replan_cb armed (fixed-world "
+                                  "supervisor cannot shrink)")
+        if self._world is None:
+            return state, False, "trainer exposes no world size"
+        survivors = self._world - 1
+        if survivors < 1:
+            return state, False, "cannot shrink below one replica"
+        plan = self.replan_cb(survivors)
+        if plan.world >= self._world:
+            return state, False, (
+                f"no feasible world below {self._world} replicas for "
+                f"{survivors} survivor(s) (global batch divisibility)")
+        if len(plan.loader) != len(self.loader):
+            return state, False, (
+                f"eviction re-plan changed steps-per-epoch "
+                f"({len(self.loader)} -> {len(plan.loader)}) — the replan "
+                "must keep the GLOBAL batch fixed")
+        if self.ckpt is not None:
+            try:
+                # same durable-anchor rule as a grow: the resize record
+                # names the just-saved label and the parity control
+                # restores it — never anchor on a write still in flight
+                self.ckpt.wait()
+            except Exception as e:  # noqa: BLE001 — anchor lost; defer
+                report.failures.append(
+                    f"{type(e).__name__}: {e} (anchor save lost at an "
+                    "eviction boundary — eviction deferred)")
+                return state, False, (
+                    f"anchor save lost ({type(e).__name__}); eviction "
+                    "deferred to the next boundary")
+        old_world = self._world
+        from .elastic import reshard_train_state
+
+        with _telemetry.span("elastic_replan", from_world=old_world,
+                             to_world=plan.world, survivors=survivors,
+                             cause=cause or "straggler_evict"):
+            state = reshard_train_state(state, old_world, plan.world,
+                                        plan.trainer,
+                                        plan.state_factory())
+        self.trainer = plan.trainer
+        self.loader = plan.loader
+        self.state_factory = plan.state_factory
+        self._world = plan.world
+        self._factories[plan.world] = plan.state_factory
+        if self.capacity_watch is not None:
+            # the evicted rank is out of service until something
+            # (capacity_return chaos, a real probe) restores it
+            self.capacity_watch.sync(survivors)
+        _telemetry.counter("elastic_resizes", 1, from_world=old_world,
+                           to_world=plan.world, survivors=survivors,
+                           direction="shrink")
+        _telemetry.gauge("world_size", plan.world)
+        report.resizes.append({
+            "from_world": old_world, "to_world": plan.world,
+            "survivors": survivors, "label": self._last_saved_label,
+            "epoch": epoch, "step": step, "direction": "shrink",
+            "cause": cause or "straggler_evict",
+            "evicted_rank": evicted_rank})
+        log_main(f"supervisor: control EVICTION — rank {evicted_rank} "
+                 f"drained, mesh re-planned {old_world} -> {plan.world} "
+                 f"replicas at epoch {epoch} step {step} (live reshard, "
+                 f"anchor checkpoint {self._last_saved_label}; capacity "
+                 f"watch debited to {survivors})")
+        return state, True, ""
+
+    def boundary_retune(self, report: RunReport, state, *, epoch: int,
+                        step: int, overrides: dict, cause: str = ""):
+        """Apply a contract-passed config re-plan at a clean segment
+        boundary: rebuild the rig at the SAME world under the new
+        TrainConfig (``retune_cb``), carry every state leaf whose
+        layout the new config preserves (params, optimizer moments, the
+        step counter — bitwise), and take the fresh template's value for
+        leaves the new config re-shapes (wire-codec error-feedback
+        buffers). The caller is responsible for gating: this method
+        trusts that the overrides already passed their contract."""
+        if self.retune_cb is None:
+            return state, False, ("no retune_cb armed (this supervisor "
+                                  "cannot rebuild its rig under a new "
+                                  "config)")
+        plan = self.retune_cb(dict(overrides))
+        if self._world is not None and plan.world != self._world:
+            return state, False, (
+                f"retune re-plan changed the world ({self._world} -> "
+                f"{plan.world}) — a retune must keep capacity fixed "
+                "(evictions/grows own world changes)")
+        if len(plan.loader) != len(self.loader):
+            return state, False, (
+                f"retune re-plan changed steps-per-epoch "
+                f"({len(self.loader)} -> {len(plan.loader)})")
+        if self.ckpt is not None:
+            try:
+                self.ckpt.wait()
+            except Exception as e:  # noqa: BLE001 — anchor lost; defer
+                report.failures.append(
+                    f"{type(e).__name__}: {e} (anchor save lost at a "
+                    "retune boundary — retune deferred)")
+                return state, False, (
+                    f"anchor save lost ({type(e).__name__}); retune "
+                    "deferred to the next boundary")
+        from .elastic import adopt_state
+
+        with _telemetry.span("control_retune", cause=cause,
+                             overrides=dict(overrides)):
+            state, resets = adopt_state(state, plan.state_factory())
+        self.trainer = plan.trainer
+        self.loader = plan.loader
+        self.state_factory = plan.state_factory
+        self._factories[plan.world] = plan.state_factory
+        _telemetry.counter("control_retunes", 1)
+        report.retunes.append({
+            "epoch": epoch, "step": step, "overrides": dict(overrides),
+            "label": self._last_saved_label, "resets": list(resets),
+            "cause": cause})
+        log_main(f"supervisor: control RETUNE — config re-planned at "
+                 f"epoch {epoch} step {step} with {overrides} (anchor "
+                 f"checkpoint {self._last_saved_label}; "
+                 f"{len(resets)} state leaf/leaves reset: {resets})")
+        return state, True, ""
 
     def _template_for_world(self, world: Optional[int]):
         """Restore template for a checkpoint recorded at ``world`` batch
@@ -623,6 +788,22 @@ class Supervisor:
                 if self.epoch_end_cb is not None:
                     self.epoch_end_cb(epoch, state, loss, acc, seconds)
                 epoch, step = epoch + 1, 0
+
+            if (self.control is not None and epoch < epochs
+                    and not (self.deathwatch is not None
+                             and self.deathwatch.died.is_set())
+                    and not (self.guard is not None
+                             and self.guard.should_stop)):
+                # Control-plane boundary hook (ISSUE 20), BEFORE the grow
+                # poll: the segment is drained and its checkpoint written
+                # — the only anchor a decision may act on. An eviction
+                # here debits the capacity watch, so the grow poll just
+                # below cannot phantom-refill the evicted share; a dying
+                # run (relay death / drain pending) never consults the
+                # control plane on its way out.
+                state = self.control.on_segment_boundary(
+                    supervisor=self, report=report, state=state,
+                    epoch=epoch, step=step)
 
             if (self.capacity_watch is not None
                     and self.replan_cb is not None and epoch < epochs

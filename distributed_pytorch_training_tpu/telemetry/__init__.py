@@ -52,6 +52,7 @@ from __future__ import annotations
 
 from .recorder import (  # noqa: F401
     ALL_RANKS_ENV,
+    CONTROL_DECISION_KIND,
     FLEET_GENERATION_ENV,
     FLEET_RANK_ENV,
     REGISTERED_SPAN_NAMES,

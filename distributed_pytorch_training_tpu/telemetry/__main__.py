@@ -53,7 +53,13 @@ from collections import defaultdict
 from pathlib import Path
 from typing import List, Optional, Tuple
 
-from .recorder import ELASTIC_SPAN_NAMES, SERVING_SPAN_NAMES, SPAN_NAMES
+from .recorder import (
+    CONTROL_DECISION_KIND,
+    CONTROL_SPAN_NAMES,
+    ELASTIC_SPAN_NAMES,
+    SERVING_SPAN_NAMES,
+    SPAN_NAMES,
+)
 
 # The per-step phases: spans that belong INSIDE an epoch's recorded wall.
 # Trailing instances with no epoch_time_s after them are a crash-truncated
@@ -99,6 +105,7 @@ def summarize(events: List[dict]) -> dict:
     gauges: dict = {}
     anomalies: List[dict] = []
     device_profiles: List[dict] = []
+    control_decisions: List[dict] = []
     meta: Optional[dict] = None
     # in-epoch spans seen since the last epoch_time_s counter: folded into
     # the accounted split by that counter's arrival, or into the PARTIAL
@@ -142,6 +149,8 @@ def summarize(events: List[dict]) -> dict:
             anomalies.append(ev)
         elif kind == "device_profile":
             device_profiles.append(ev)
+        elif kind == CONTROL_DECISION_KIND:
+            control_decisions.append(ev)
         elif kind == "meta":
             # a relaunch appended to the same stream: whatever the
             # previous run left pending was truncated, not completed
@@ -162,7 +171,7 @@ def summarize(events: List[dict]) -> dict:
     wall_ms = counters.get("epoch_time_s", 0.0) * 1e3
     accounted = {n: spans[n]["total_ms"] - partial_ms.get(n, 0.0)
                  for n in SPAN_NAMES + SERVING_SPAN_NAMES
-                 + ELASTIC_SPAN_NAMES if n in spans}
+                 + ELASTIC_SPAN_NAMES + CONTROL_SPAN_NAMES if n in spans}
     accounted = {n: v for n, v in accounted.items() if v > 0.0}
     accounted_ms = sum(accounted.values())
     split = {}
@@ -214,6 +223,25 @@ def summarize(events: List[dict]) -> dict:
             "windows": windows,
         }
 
+    # control-plane decisions (ISSUE 20): the audit trail the autopilot
+    # leaves on the stream — every record kept in order so the summary
+    # shows the full detect -> evict -> grow / retune -> refuse chain
+    control = None
+    if control_decisions:
+        by_action: dict = defaultdict(int)
+        for ev in control_decisions:
+            by_action[str(ev.get("name", "?"))] += 1
+        control = {
+            "total": len(control_decisions),
+            "by_action": dict(sorted(by_action.items())),
+            "chain": [{("action" if k == "name" else k): ev.get(k)
+                       for k in ("name", "rank", "epoch", "step",
+                                 "world_from", "world_to", "applied",
+                                 "reason")
+                       if ev.get(k) is not None}
+                      for ev in control_decisions],
+        }
+
     partial_total = sum(partial_ms.values())
     partial_epoch = None
     if partial_steps or partial_total > 0.0:
@@ -242,6 +270,7 @@ def summarize(events: List[dict]) -> dict:
                       for a in anomalies],
         "step_split_pct": split,
         "device": device,
+        "control_decisions": control,
         "partial_epoch": partial_epoch,
         "totals": {
             "recorded_wall_ms": round(wall_ms, 3),
@@ -330,6 +359,19 @@ def _print_summary(s: dict) -> None:
             mfu = (f", measured MFU {w['measured_mfu_pct']:.1f}%"
                    if w.get("measured_mfu_pct") is not None else "")
             print(f"  window: {rng} ({w.get('reason', '?')}{trig}{mfu})")
+    if s.get("control_decisions"):
+        c = s["control_decisions"]
+        acts = ", ".join(f"{a}={n}" for a, n in c["by_action"].items())
+        print(f"control decisions ({c['total']}): {acts}")
+        for d in c["chain"]:
+            who = f" rank {d['rank']}" if d.get("rank") is not None else ""
+            at = (f" @epoch {d['epoch']} step {d['step']}"
+                  if d.get("step") is not None else "")
+            world = (f" world {d['world_from']}->{d['world_to']}"
+                     if d.get("world_to") is not None else "")
+            applied = " [applied]" if d.get("applied") else ""
+            print(f"  {d.get('action'):7s}{who}{at}{world}{applied}: "
+                  f"{d.get('reason', '')}")
     if s.get("partial_epoch"):
         pe = s["partial_epoch"]
         phases = ", ".join(f"{n} {v:.1f}ms"

@@ -48,6 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..utils.locktrace import named_lock
 from .device import DEVICE_PROFILE_KIND, split_of_event
 from .recorder import (
+    CONTROL_DECISION_KIND,
+    CONTROL_SPAN_NAMES,
     ELASTIC_SPAN_NAMES,
     Recorder,
     SCHEMA_VERSION,
@@ -58,7 +60,8 @@ from .recorder import (
 METRICS_PORT_ENV = "DPT_METRICS_PORT"
 METRICS_STALE_S_ENV = "DPT_METRICS_STALE_S"
 
-_PHASES = SPAN_NAMES + SERVING_SPAN_NAMES + ELASTIC_SPAN_NAMES
+_PHASES = (SPAN_NAMES + SERVING_SPAN_NAMES + ELASTIC_SPAN_NAMES
+           + CONTROL_SPAN_NAMES)
 
 # seconds; the +Inf bucket is implicit. Spans range from ~100us CPU-mesh
 # dispatches to multi-second compiles/stalls.
@@ -117,6 +120,9 @@ class _MetricsState:
         self.device_seconds: Dict[str, float] = {}                 # guarded-by: _lock
         self.device_profiles = 0                                   # guarded-by: _lock
         self.exposed_comm_ratio: Optional[float] = None            # guarded-by: _lock
+        # control-plane decisions (ISSUE 20): action -> count, fed by
+        # control_decision events (name = the action)
+        self.control_decisions: Dict[str, int] = {}                # guarded-by: _lock
 
     # -- the observer ---------------------------------------------------
 
@@ -173,6 +179,9 @@ class _MetricsState:
                     self.gauges[name] = float(ev.get("value", 0.0))
                 except (TypeError, ValueError):
                     pass
+            elif kind == CONTROL_DECISION_KIND:
+                self.control_decisions[name] = (
+                    self.control_decisions.get(name, 0) + 1)
             elif kind == DEVICE_PROFILE_KIND:
                 for phase, ms in split_of_event(ev).items():
                     self.device_seconds[phase] = (
@@ -246,6 +255,12 @@ class _MetricsState:
                 for name, v in sorted(self.gauges.items()):
                     lines.append(
                         f'dpt_gauge{{name="{_escape_label(name)}"}} {v:g}')
+            if self.control_decisions:
+                lines.append("# TYPE dpt_control_decisions_total counter")
+                for action, n in sorted(self.control_decisions.items()):
+                    lines.append(
+                        f'dpt_control_decisions_total{{action='
+                        f'"{_escape_label(action)}"}} {n}')
             if self.device_profiles:
                 lines.append("# TYPE dpt_device_profiles_total counter")
                 lines.append(

@@ -112,15 +112,33 @@ ELASTIC_SPAN_NAMES = ("elastic_replan", "elastic_reshard", "elastic_grow",
 
 # Registered-but-unaccounted span names: visible in the spans table, never
 # summed into the step-time split (the `compile` double-count rationale
-# above). Together the four tuples are THE span-name registry — the
+# above). Together the five tuples are THE span-name registry — the
 # `span-names-registered` AST rule (analysis/ast_rules.py) flags any
 # in-repo emission whose literal name is not in it, because `telemetry
 # summary` silently buckets unknown names into "unaccounted": a typo'd
 # span name would vanish from the split instead of failing loudly.
 AUX_SPAN_NAMES = ("compile",)
 
+# The control-plane phases (ISSUE 20): `control_apply` wraps one
+# `control.apply_decision` — the sole sanctioned entry from policy to the
+# Supervisor's re-plan surface — and `control_retune` wraps the
+# Supervisor's segment-boundary config re-plan (the online tuner's
+# apply). Like `compile`, these run INSIDE the segment wall they act on,
+# so they are registered-but-unaccounted: visible in the spans table,
+# never summed into the step-time split.
+CONTROL_SPAN_NAMES = ("control_apply", "control_retune")
+
 REGISTERED_SPAN_NAMES = (SPAN_NAMES + SERVING_SPAN_NAMES
-                         + ELASTIC_SPAN_NAMES + AUX_SPAN_NAMES)
+                         + ELASTIC_SPAN_NAMES + AUX_SPAN_NAMES
+                         + CONTROL_SPAN_NAMES)
+
+# Event kind of one ControlDecision record (control/decisions.py): the
+# policy layer's typed decisions ride the same stream as every other
+# instrument — `telemetry summary` renders them, metrics_http counts
+# them as `dpt_control_decisions_total{action}`. Defined here (not in
+# control/) so the jax-free telemetry readers never import the policy
+# layer.
+CONTROL_DECISION_KIND = "control_decision"
 
 
 # ---------------------------------------------------------------------------

@@ -242,6 +242,24 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
                              "(telemetry/metrics_http.py). Default: "
                              "DPT_METRICS_PORT env, else off — off "
                              "starts zero threads")
+    parser.add_argument("--autopilot", action="store_true",
+                        help="attach the control-plane autopilot "
+                             "(control/): straggler detection over the "
+                             "telemetry stream with gated eviction at "
+                             "segment boundaries. Requires "
+                             "--max-restarts (the Supervisor owns the "
+                             "boundaries) and telemetry ON. Off (the "
+                             "default) constructs nothing: zero threads, "
+                             "zero observers, an event stream and "
+                             "lowered HLO byte-identical to a build "
+                             "without the control package")
+    parser.add_argument("--autopilot-tune", action="store_true",
+                        help="also arm the autopilot's online perf "
+                             "tuner: exposed-comm ratios from profiled "
+                             "windows propose a wire re-plan, applied at "
+                             "a segment boundary ONLY after the "
+                             "control_replan contract matrix passes the "
+                             "candidate (refused and logged otherwise)")
     parser.add_argument("--telemetry-abort", action="store_true",
                         help="turn the anomaly watchdog's abort hook ON: "
                              "a detected non-finite loss / step-time spike "

@@ -424,6 +424,87 @@ class TestProfilerSessionHome:
 
 
 # ---------------------------------------------------------------------------
+# control-decisions-gated (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestControlDecisionsGated:
+    RULE = ["control-decisions-gated"]
+
+    def test_mutation_every_reference_form_flags(self, tmp_path):
+        """A control/ policy module touching the re-plan surface is the
+        gate bypass this rule exists for — attribute calls, bare names
+        from imports, AND bound-method aliasing (the one-extra-hop
+        bypass) must all flag."""
+        for src in (
+            "def decide(sup, report, state):\n"
+            "    return sup.boundary_shrink(report, state, epoch=0,"
+            " step=1)\n",
+            "def decide(sup, report, state):\n"
+            "    return sup.boundary_retune(report, state, epoch=0,"
+            " step=1, overrides={})\n",
+            "from ..resilience.elastic import reshard_train_state\n"
+            "def decide(state):\n"
+            "    return reshard_train_state(state, 8, 4, None, None)\n",
+            "from ..resilience.elastic import plan_elastic_world\n"
+            "W = plan_elastic_world(7, 16)\n",
+            "def decide(sup):\n"
+            "    commit = sup.boundary_shrink\n"   # aliasing is the same
+            "    return commit\n",                  # bypass
+            "def decide(sup, report, state, epoch, step):\n"
+            "    return sup._maybe_grow(report, state, epoch, step)\n",
+            "def decide(sup):\n"
+            "    return sup.replan_cb(4)\n",
+        ):
+            findings = _lint(tmp_path, src, rules=self.RULE,
+                             name="control/policy.py")
+            assert findings, f"did not flag: {src!r}"
+            assert _rules_of(findings) == set(self.RULE)
+
+    def test_apply_home_is_exempt(self, tmp_path):
+        """control/apply.py IS the one sanctioned entry — the same code
+        there is clean; a lookalike directory must not inherit the
+        exemption."""
+        src = ("def _apply_evict(sup, report, state):\n"
+               "    return sup.boundary_shrink(report, state, epoch=0,"
+               " step=1)\n")
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="control/apply.py") == []
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="mycontrol/apply.py") == []   # not a control/ dir
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="control/apply_helpers.py") != []
+
+    def test_outside_control_is_out_of_scope(self, tmp_path):
+        """The Supervisor and the elastic module CALL this surface —
+        that is their job; the rule binds only inside control/."""
+        src = ("def run(sup, report, state):\n"
+               "    return sup.boundary_shrink(report, state, epoch=0,"
+               " step=1)\n")
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="resilience/supervisor_helper.py") == []
+
+    def test_docstring_mentions_do_not_flag(self, tmp_path):
+        src = '''
+            """Policies PROPOSE; control/apply.py commits via
+            Supervisor.boundary_shrink / boundary_retune after the
+            contract gate (reshard_train_state, plan_elastic_world)."""
+            NOTE = "see boundary_retune for the apply path"
+
+            def propose():
+                """Docs quoting replan_cb(survivors) are not a call."""
+                return NOTE
+        '''
+        assert _lint(tmp_path, src, rules=self.RULE,
+                     name="control/notes.py") == []
+
+    def test_repo_control_package_is_clean(self):
+        """The rule binds on the real tree: every re-plan reference in
+        control/ lives in apply.py."""
+        assert run_ast_rules(rules=self.RULE) == []
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics
 # ---------------------------------------------------------------------------
 
@@ -683,6 +764,7 @@ class TestSpanNamesRegistered:
         assert run_ast_rules(rules=["span-names-registered"]) == []
 
 
+@pytest.mark.slow  # ~6 s; strictly redundant with the check --json gate in test_analysis_cli, which runs every AST rule over the repo
 def test_repo_is_clean_under_every_ast_rule():
     """The tier-1 gate for the source-level contracts: the package and the
     top-level scripts carry zero violations (suppressions included)."""

@@ -30,6 +30,7 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
                              "fsdp_tp", "fsdp_tp_int8_mh",
                              "serving_decode", "serving_paged",
                              "serving_spec",
+                             "control_replan",
                              "elastic_reshard",
                              "elastic_grow"}
     assert all(s == "pass" for s in statuses.values()), statuses
@@ -58,6 +59,8 @@ def test_analysis_check_json_exits_0_on_repo(capsys, devices):
     assert "lock-order-acyclic" in kinds
     assert "no-blocking-under-lock" in kinds
     assert "thread-lifecycle" in kinds
+    # the control-plane gate (ISSUE 20)
+    assert "control-decisions-gated" in kinds
 
 
 def test_ast_only_is_fast_and_clean(capsys):

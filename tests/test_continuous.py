@@ -378,6 +378,83 @@ class TestInt8Pages:
             np.testing.assert_array_equal(a.last_logits, b.last_logits)
 
 
+class TestFusedPagedScatter:
+    """ISSUE 20 satellite: the int8 page write path rides the PR 6 fused
+    Pallas quantize kernels — every paged scatter (row, window, prefill)
+    threads the codec's ``fused`` tri-state down to
+    ``grad_sync._quantize_int8_rows``. On CPU the kernel runs in Pallas
+    interpreter mode, and the PR 6 exactness model says the pool BYTES
+    cannot depend on the flag: codes AND scales bitwise identical, fused
+    vs the XLA-composed reference."""
+
+    L, PAGES, PS, H, D = 2, 5, 4, 2, 8
+
+    def _pool(self):
+        from distributed_pytorch_training_tpu.models.layers import (
+            init_paged_kv,
+        )
+
+        return init_paged_kv(self.L, self.PAGES, self.PS, self.H, self.D,
+                             quantized=True)
+
+    def _rand(self, shape, seed):
+        return jnp.asarray(np.random.RandomState(seed)
+                           .randn(*shape).astype(np.float32))
+
+    def _assert_pools_bitwise(self, a, b):
+        for leaf in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, leaf)), np.asarray(getattr(b, leaf)),
+                err_msg=f"paged pool leaf {leaf} depends on the fused flag")
+
+    def test_row_scatter_fused_is_bitwise(self):
+        from distributed_pytorch_training_tpu.models.layers import (
+            scatter_paged_rows,
+        )
+
+        table = jnp.array([[1, 2], [3, 4], [2, 1]], jnp.int32)
+        positions = jnp.array([0, 5, 3], jnp.int32)
+        active = jnp.array([True, True, False])
+        k = self._rand((self.L, 3, self.H, self.D), seed=0)
+        v = self._rand((self.L, 3, self.H, self.D), seed=1)
+        out = {f: scatter_paged_rows(self._pool(), table, positions, k, v,
+                                     active, fused=f)
+               for f in (False, True)}
+        self._assert_pools_bitwise(out[False], out[True])
+        assert np.asarray(out[True].k).any()  # the write actually landed
+
+    def test_window_scatter_fused_is_bitwise(self):
+        from distributed_pytorch_training_tpu.models.layers import (
+            scatter_paged_window,
+        )
+
+        table = jnp.array([[1, 2], [3, 4]], jnp.int32)
+        positions = jnp.array([[0, 1, 2], [4, 5, 6]], jnp.int32)
+        active = jnp.array([[True, True, False], [True, True, True]])
+        k = self._rand((self.L, 2, 3, self.H, self.D), seed=2)
+        v = self._rand((self.L, 2, 3, self.H, self.D), seed=3)
+        out = {f: scatter_paged_window(self._pool(), table, positions, k,
+                                       v, active, fused=f)
+               for f in (False, True)}
+        self._assert_pools_bitwise(out[False], out[True])
+        assert np.asarray(out[True].k).any()
+
+    def test_prefill_scatter_fused_is_bitwise(self):
+        from distributed_pytorch_training_tpu.models.layers import (
+            scatter_paged_prefill,
+        )
+
+        page_row = jnp.array([1, 3], jnp.int32)
+        k = self._rand((self.L, 2 * self.PS, self.H, self.D), seed=4)
+        v = self._rand((self.L, 2 * self.PS, self.H, self.D), seed=5)
+        length = jnp.int32(6)  # bucket padding past 6 must be dropped
+        out = {f: scatter_paged_prefill(self._pool(), page_row, k, v,
+                                        length, fused=f)
+               for f in (False, True)}
+        self._assert_pools_bitwise(out[False], out[True])
+        assert np.asarray(out[True].k).any()
+
+
 # ---------------------------------------------------------------------------
 # Telemetry: registered spans, live gauges, summary bucketing
 # ---------------------------------------------------------------------------

@@ -1,0 +1,716 @@
+"""control/ (ISSUE 20): the self-driving fleet's policy layer.
+
+Unit legs pin each loop in isolation — the straggler persistence policy
+(N consecutive flagged steps, history dropped across resizes), the
+capacity probes and their CONTAINMENT inside CapacityWatch (a raising or
+hanging feed degrades to the last committed reading, never the poll/grow
+path), the contract gate (a failing candidate is refused with findings,
+never applied), and `apply_decision` as the one entry to the re-plan
+surface. Live legs drive the real Supervisor: a gated `boundary_retune`
+at a segment boundary (applied AND refused twins — the refused run must
+continue on the old config), the autopilot-off pin (control=None leaves
+the stream byte-free of control events), and the acceptance e2e —
+`resilience chaos --autopilot` proving detect -> evict -> grow with
+bitwise post-resize parity, then feeding the SAME stream back through
+/metrics and `telemetry summary` so every renderer of the decision
+record is pinned against the artifact the run actually wrote.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from distributed_pytorch_training_tpu import telemetry
+from distributed_pytorch_training_tpu.control import (
+    Autopilot, CONTROL_DECISION_KIND, ControlDecision, DECISION_ACTIONS,
+    FileCapacityFeed, PerfTuner, StragglerEvictionPolicy, TUNABLE_KEYS,
+    apply_decision, contract_gate, emit_decision, heartbeat_capacity_probe,
+)
+from distributed_pytorch_training_tpu.control.tuner import DEFAULT_CANDIDATE
+from distributed_pytorch_training_tpu.resilience.capacity import CapacityWatch
+from distributed_pytorch_training_tpu.telemetry.aggregate import (
+    StreamSegment, detect_stragglers,
+)
+from distributed_pytorch_training_tpu.telemetry.device import (
+    DEVICE_PROFILE_KIND,
+)
+
+
+@pytest.fixture
+def stream(tmp_path):
+    """A configured telemetry recorder writing to a tmp JSONL."""
+    path = tmp_path / "stream.jsonl"
+    telemetry.configure(str(path))
+    yield path
+    telemetry.reset()
+
+
+def _tail(n=200):
+    rec = telemetry.get()
+    return rec.tail(n) if rec is not None else []
+
+
+def _probe_threads():
+    return sum(1 for t in threading.enumerate()
+               if t.name == "dpt-capacity-probe")
+
+
+# ---------------------------------------------------------------------------
+# the decision record
+# ---------------------------------------------------------------------------
+
+
+class TestControlDecision:
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown control action"):
+            ControlDecision(action="reboot", reason="nope")
+
+    def test_fields_casts_and_skips_none(self):
+        d = ControlDecision(action="evict", reason="slow", rank=3,
+                            world_from=8.0, world_to=4,
+                            evidence={"steps": [5, 6, 7]})
+        f = d.fields()
+        assert f["action"] == "evict" and f["applied"] is False
+        assert f["rank"] == 3 and isinstance(f["world_from"], int)
+        assert f["evidence"] == {"steps": [5, 6, 7]}
+        assert "epoch" not in f and "step" not in f  # None fields dropped
+
+    def test_emit_unconfigured_is_a_noop(self):
+        telemetry.reset()
+        d = ControlDecision(action="detect", reason="r")
+        assert emit_decision(d) is d  # no raise, chains the decision
+
+    def test_emit_lands_on_the_stream(self, stream):
+        emit_decision(ControlDecision(action="grow", reason="back",
+                                      world_from=4, world_to=8,
+                                      applied=True))
+        evs = [e for e in _tail() if e.get("kind") == CONTROL_DECISION_KIND]
+        assert len(evs) == 1
+        ev = evs[0]
+        # the event carries BOTH name (what the renderers key on) and the
+        # action field (the chaos CLI's rename target)
+        assert ev["name"] == "grow" and ev["action"] == "grow"
+        assert ev["applied"] is True and ev["world_to"] == 8
+        assert set(DECISION_ACTIONS) >= {"detect", "evict", "grow",
+                                         "retune", "refuse"}
+
+
+# ---------------------------------------------------------------------------
+# loop (1): the persistence policy
+# ---------------------------------------------------------------------------
+
+
+def _row(step, rank=1, gen=0, dur=1.0, factor=10.0, phase="data_wait"):
+    return {"gen": gen, "rank": rank, "step": step, "phase": phase,
+            "dur_s": dur, "baseline_s": dur / factor, "factor": factor,
+            "basis": "peers_at_step", "peers": 7}
+
+
+class TestStragglerPolicy:
+    def test_n_minus_one_flags_do_not_convict(self):
+        """The ISSUE 20 edge satellite: N-1 consecutive flags must NOT
+        trigger eviction; the Nth does."""
+        pol = StragglerEvictionPolicy(n_consecutive=3)
+        pol.observe_rows([_row(5), _row(6)])
+        assert pol.verdict() is None
+        pol.observe_rows([_row(7)])
+        v = pol.verdict()
+        assert v is not None and v["rank"] == 1 and v["steps"] == [5, 6, 7]
+
+    def test_non_consecutive_flags_do_not_convict(self):
+        pol = StragglerEvictionPolicy(n_consecutive=3)
+        pol.observe_rows([_row(5), _row(7), _row(9)])
+        assert pol.verdict() is None
+
+    def test_resize_drops_history(self):
+        """Rank labels remap across ANY resize: two pre-resize flags on
+        rank 1 plus one post-resize flag on 'rank 1' (a different host
+        now) must not convict — the persistence-across-resize pin."""
+        pol = StragglerEvictionPolicy(n_consecutive=3)
+        pol.observe_rows([_row(5), _row(6)])
+        pol.note_resize()
+        pol.observe_rows([_row(7)])
+        assert pol.verdict() is None
+        assert pol.flagged_steps(0, 1) == [7]
+
+    def test_observe_is_idempotent_keeping_worst(self):
+        pol = StragglerEvictionPolicy(n_consecutive=1)
+        pol.observe_rows([_row(5, dur=1.0)])
+        pol.observe_rows([_row(5, dur=2.0), _row(5, dur=0.5)])
+        assert pol.flagged_steps(0, 1) == [5]
+        assert pol.verdict()["evidence"]["dur_s"] == 2.0
+
+    def test_phases_outside_the_policy_are_ignored(self):
+        pol = StragglerEvictionPolicy(n_consecutive=1)
+        pol.observe_rows([_row(5, phase="eval_epoch")])
+        assert pol.verdict() is None
+
+    def test_worst_rank_wins(self):
+        pol = StragglerEvictionPolicy(n_consecutive=3)
+        pol.observe_rows([_row(s, rank=1) for s in (5, 6, 7)])
+        pol.observe_rows([_row(s, rank=2) for s in (4, 5, 6, 7)])
+        assert pol.verdict()["rank"] == 2  # longer run convicts first
+
+    def test_bad_n_rejected(self):
+        with pytest.raises(ValueError):
+            StragglerEvictionPolicy(n_consecutive=0)
+
+
+def _span_seg(rank, durs_ms, phase="data_wait", gen=0):
+    """One synthetic stream segment: {step: dur_ms} spans of one phase."""
+    events = [{"kind": "span", "name": phase, "step": s, "dur_ms": d}
+              for s, d in sorted(durs_ms.items())]
+    return StreamSegment(gen=gen, rank=rank, path=f"<r{rank}>",
+                         anchor_ts=0.0, events=events)
+
+
+class TestDetectorFeedsPolicy:
+    def test_detector_rows_convict_the_stalled_rank(self):
+        """The live wiring the autopilot rides: detect_stragglers over
+        peer segments -> policy -> verdict names the persistent rank."""
+        fast = _span_seg(0, {s: 1.0 for s in range(4, 8)})
+        slow = _span_seg(1, {4: 1.0, 5: 900.0, 6: 900.0, 7: 900.0})
+        rows = detect_stragglers([fast, slow])
+        assert {r["step"] for r in rows} == {5, 6, 7}
+        assert all(r["rank"] == 1 and r["basis"] == "peers_at_step"
+                   for r in rows)
+        pol = StragglerEvictionPolicy(n_consecutive=3)
+        pol.observe_rows(rows)
+        v = pol.verdict()
+        assert v["rank"] == 1 and v["steps"] == [5, 6, 7]
+        assert v["evidence"]["dur_s"] == 0.9
+
+    def test_first_dispatch_exemption_holds_through_the_feed(self):
+        """A relaunch's compile-dominated first step_dispatch must not
+        feed the policy a phantom flag."""
+        segs = [_span_seg(r, {0: 1.0, 1: 1.0}, phase="step_dispatch")
+                for r in range(4)]
+        segs.append(_span_seg(7, {0: 5000.0, 1: 1.0},
+                              phase="step_dispatch"))
+        rows = detect_stragglers(segs)
+        assert rows == []
+
+
+# ---------------------------------------------------------------------------
+# loop (3): capacity probes + containment
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatProbe:
+    def test_proportional_to_up_ports(self):
+        import socket
+
+        live = socket.socket()
+        live.bind(("127.0.0.1", 0))
+        live.listen(1)
+        live_port = live.getsockname()[1]
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()  # nothing listens here now
+        try:
+            probe = heartbeat_capacity_probe(
+                8, ports=[live_port, dead_port], timeout=0.5)
+            assert probe() == 4  # 8 * 1 // 2
+        finally:
+            live.close()
+
+    def test_empty_registry_reads_full_capacity(self):
+        assert heartbeat_capacity_probe(8, ports=[])() == 8
+
+    def test_negative_total_rejected(self):
+        with pytest.raises(ValueError):
+            heartbeat_capacity_probe(-1, ports=[])
+
+
+class TestFileCapacityFeed:
+    def test_round_trip_and_failures_raise(self, tmp_path):
+        feed = FileCapacityFeed(tmp_path / "cap.txt")
+        with pytest.raises(OSError):
+            feed()  # missing file: the watch contains this, not the feed
+        feed.write(5)
+        assert feed() == 5
+        Path(feed.path).write_text("not-a-number\n")
+        with pytest.raises(ValueError):
+            feed()
+
+
+class TestProbeContainment:
+    def test_raising_probe_degrades_to_last_committed(self, stream):
+        """The containment satellite: a feed that works once then breaks
+        costs staleness (last committed reading) plus a loud counter —
+        never an exception out of available()."""
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] > 1:
+                raise RuntimeError("feed endpoint down")
+            return 5
+
+        watch = CapacityWatch(total=8, probe=flaky)
+        assert watch.available() == 5
+        assert watch.available() == 5   # degraded, not crashed
+        errs = [e for e in _tail() if e.get("kind") == "counter"
+                and e.get("name") == "capacity_probe_errors"]
+        assert errs and errs[-1]["error"] == "RuntimeError"
+
+    def test_raising_probe_never_kills_poll_grow(self, stream):
+        watch = CapacityWatch(total=8, probe=lambda: 1 / 0)
+        # poll path survives and answers off the committed count (8 > 4)
+        assert watch.poll_grow(4) == 8
+        assert watch.poll_grow(8) is None
+
+    def test_probe_readings_clamped_to_total(self):
+        assert CapacityWatch(total=8, probe=lambda: 999).available() == 8
+        assert CapacityWatch(total=8, probe=lambda: -3).available() == 0
+
+    def test_hanging_probe_times_out_fast(self, stream):
+        """With probe_timeout_s armed, a hung feed degrades within the
+        budget (boxed on the dpt-capacity-probe worker) and the NEXT
+        poll fails fast instead of queueing behind the wedged call."""
+        release = threading.Event()
+
+        def hang():
+            release.wait(30.0)
+            return 3
+
+        before = _probe_threads()
+        watch = CapacityWatch(total=8, probe=hang, probe_timeout_s=0.2)
+        t0 = time.monotonic()
+        assert watch.available() == 8       # degraded to committed
+        assert watch.available() == 8       # fail-fast on the stale call
+        assert time.monotonic() - t0 < 5.0
+        assert _probe_threads() == before + 1
+        errs = [e for e in _tail() if e.get("kind") == "counter"
+                and e.get("name") == "capacity_probe_errors"]
+        assert len(errs) >= 2
+        assert all(e["error"] == "TimeoutError" for e in errs[-2:])
+        release.set()  # let the boxed call finish; worker parks on its queue
+
+    def test_no_timeout_means_no_worker_thread(self):
+        """The autopilot-off thread pin: a plain probe (no timeout) is a
+        direct call — zero threads appear."""
+        before = _probe_threads()
+        watch = CapacityWatch(total=8, probe=lambda: 6)
+        assert watch.available() == 6
+        assert _probe_threads() == before
+
+
+# ---------------------------------------------------------------------------
+# loop (2): the contract gate + apply_decision
+# ---------------------------------------------------------------------------
+
+
+class TestContractGate:
+    def test_non_tunable_key_refused_without_lowering(self):
+        ok, refusals = contract_gate({"learning_rate": 0.1})
+        assert ok is False
+        assert "non-tunable" in refusals[0]
+        assert all(k in TUNABLE_KEYS for k in DEFAULT_CANDIDATE)
+
+    def test_unloweable_config_refused_not_raised(self):
+        ok, refusals = contract_gate({"wire_dtype": "no_such_wire"})
+        assert ok is False and refusals
+
+    def test_default_candidate_passes_the_real_matrix(self):
+        """The candidate the tuner actually proposes (int8 multihop +
+        tiny bucket cap) must clear the full HLO rule set over the
+        control_replan base — the gate's approve leg, lowered for real."""
+        ok, refusals = contract_gate(dict(DEFAULT_CANDIDATE))
+        assert ok is True, refusals
+
+
+class _StubSup:
+    """A Supervisor-shaped stub: scripted boundary_* results, recorded
+    calls — apply_decision's contract without a mesh."""
+
+    def __init__(self, world=8, shrink=None, retune=None):
+        self._world = world
+        self._shrink = shrink
+        self._retune = retune
+        self.calls = []
+
+    @property
+    def world_size(self):
+        return self._world
+
+    def boundary_shrink(self, report, state, *, epoch, step,
+                        evicted_rank=None, cause=""):
+        self.calls.append(("shrink", evicted_rank, cause))
+        new_state, applied, detail, new_world = self._shrink
+        if applied:
+            self._world = new_world
+        return new_state, applied, detail
+
+    def boundary_retune(self, report, state, *, epoch, step, overrides,
+                        cause=""):
+        self.calls.append(("retune", dict(overrides), cause))
+        new_state, applied, detail = self._retune
+        return new_state, applied, detail
+
+
+class TestApplyDecision:
+    def test_observation_actions_are_not_applicable(self, stream):
+        sup = _StubSup()
+        with pytest.raises(ValueError, match="not applicable"):
+            apply_decision(sup, ControlDecision(action="detect", reason="r"),
+                           report=None, state="s", epoch=0, step=1)
+
+    def test_evict_applied_records_worlds_and_canonical_cause(self, stream):
+        sup = _StubSup(world=8, shrink=("new", True, "", 4))
+        state, final = apply_decision(
+            sup, ControlDecision(action="evict", reason="free text", rank=3),
+            report=None, state="old", epoch=1, step=4)
+        assert state == "new"
+        assert final.applied and final.action == "evict"
+        assert (final.world_from, final.world_to) == (8, 4)
+        # the resize record's cause is the canonical tag, never free text
+        assert sup.calls == [("shrink", 3, "straggler_evict")]
+        names = [e.get("name") for e in _tail()]
+        assert "evict" in names and "control_apply" in names
+
+    def test_evict_refusal_emits_refuse_and_keeps_state(self, stream):
+        sup = _StubSup(world=2, shrink=("old", False, "cannot shrink "
+                                        "below one replica", 2))
+        state, final = apply_decision(
+            sup, ControlDecision(action="evict", reason="r", rank=0),
+            report=None, state="old", epoch=0, step=2)
+        assert state == "old" and final.action == "refuse"
+        assert final.applied is False
+        assert final.evidence["refused_action"] == "evict"
+        assert "cannot shrink" in final.evidence["refusals"][0]
+
+    def test_retune_without_overrides_refused(self, stream):
+        sup = _StubSup(retune=("new", True, ""))
+        _, final = apply_decision(
+            sup, ControlDecision(action="retune", reason="r"),
+            report=None, state="s", epoch=0, step=2)
+        assert final.action == "refuse" and sup.calls == []
+
+    def test_failing_gate_refuses_before_the_replan_surface(self, stream):
+        """The acceptance clause: a candidate failing its contract is
+        REFUSED AND LOGGED — boundary_retune is never reached."""
+        sup = _StubSup(retune=("new", True, ""))
+        _, final = apply_decision(
+            sup, ControlDecision(action="retune", reason="r",
+                                 evidence={"overrides": {"wire_dtype":
+                                                         "int8"}}),
+            report=None, state="s", epoch=0, step=2,
+            gate=lambda o: (False, ["exactness finding: drift"]))
+        assert final.action == "refuse" and sup.calls == []
+        assert final.evidence["refusals"] == ["exactness finding: drift"]
+        refuses = [e for e in _tail()
+                   if e.get("kind") == CONTROL_DECISION_KIND
+                   and e.get("name") == "refuse"]
+        assert refuses, "a refused candidate must still be on the stream"
+
+    def test_passing_gate_commits_the_retune(self, stream):
+        sup = _StubSup(world=8, retune=("new", True, ""))
+        state, final = apply_decision(
+            sup, ControlDecision(action="retune", reason="comm-bound",
+                                 evidence={"overrides": {"wire_dtype":
+                                                         "bf16"}}),
+            report=None, state="s", epoch=0, step=2,
+            gate=lambda o: (True, []))
+        assert state == "new" and final.applied
+        assert sup.calls == [("retune", {"wire_dtype": "bf16"},
+                              "comm-bound")]
+
+
+# ---------------------------------------------------------------------------
+# the tuner
+# ---------------------------------------------------------------------------
+
+
+class TestPerfTuner:
+    def _window(self, ratio):
+        return {"kind": DEVICE_PROFILE_KIND, "exposed_comm_ratio": ratio}
+
+    def test_proposes_once_above_threshold(self):
+        t = PerfTuner(threshold=0.3, min_windows=2)
+        t.observe(self._window(0.5))
+        assert t.propose() is None          # one window is weather
+        t.observe(self._window(0.7))
+        p = t.propose()
+        assert p["overrides"] == DEFAULT_CANDIDATE
+        assert p["evidence"]["windows"] == 2
+        assert p["evidence"]["mean_exposed_comm_ratio"] == 0.6
+        assert t.propose() is None          # one-shot until reset
+        t.reset()
+        assert t.windows == 0
+
+    def test_below_threshold_or_wrong_kind_is_quiet(self):
+        t = PerfTuner(threshold=0.5, min_windows=1)
+        t.observe({"kind": "span", "exposed_comm_ratio": 0.9})
+        t.observe(self._window(0.2))
+        assert t.propose() is None
+
+    def test_already_on_candidate_wire_is_quiet(self):
+        t = PerfTuner(threshold=0.1, min_windows=1)
+        t.observe(self._window(0.9))
+        assert t.propose({"wire_dtype": "int8_multihop"}) is None
+
+    def test_invalid_candidate_keys_rejected(self):
+        with pytest.raises(ValueError, match="not.*tunable"):
+            PerfTuner(candidate={"learning_rate": 0.1})
+
+
+# ---------------------------------------------------------------------------
+# the autopilot object
+# ---------------------------------------------------------------------------
+
+
+class TestAutopilotUnit:
+    def test_attach_requires_configured_telemetry(self):
+        telemetry.reset()
+        with pytest.raises(RuntimeError, match="configured telemetry"):
+            Autopilot().attach()
+
+    def test_observer_buffers_only_policy_phases(self, stream):
+        ap = Autopilot().attach()
+        try:
+            telemetry.span_event("data_wait", 0.9, step=5)
+            telemetry.span_event("forward", 0.9, step=5)
+            telemetry.emit(CONTROL_DECISION_KIND, "detect", reason="r")
+            buffered = ap._drain()
+            assert [e["name"] for e in buffered] == ["data_wait"]
+        finally:
+            ap.detach()
+        telemetry.span_event("data_wait", 0.9, step=6)
+        assert len(ap._drain()) == 1  # detached: nothing new buffered
+
+    def test_readmission_emits_the_grow_decision(self, stream):
+        """World back at the pre-eviction size -> one applied grow
+        decision, suspension lifted, history cleared."""
+        ap = Autopilot().attach()
+        try:
+            ap._last_world = 4
+            ap._pending_readmit = 8
+            ap._evicted_rank = 3
+            ap.policy.observe_rows([_row(5), _row(6), _row(7)])
+            state = ap.on_segment_boundary(
+                supervisor=_StubSup(world=8), report=None, state="s",
+                epoch=1, step=12)
+            assert state == "s"
+            (grow,) = ap.decisions
+            assert grow.action == "grow" and grow.applied
+            assert (grow.world_from, grow.world_to) == (4, 8)
+            assert grow.rank == 3
+            assert ap._pending_readmit is None
+            # stale pre-grow history must not convict the renumbered rank
+            assert ap.policy.verdict() is None
+        finally:
+            ap.detach()
+
+    def test_detection_suspended_while_capacity_is_out(self, stream):
+        ap = Autopilot().attach()
+        try:
+            ap._last_world = 4
+            ap._pending_readmit = 8
+            telemetry.span_event("data_wait", 5.0, step=9)
+            ap.on_segment_boundary(supervisor=_StubSup(world=4),
+                                   report=None, state="s", epoch=1, step=10)
+            assert ap.decisions == []  # no detect while shrunken
+        finally:
+            ap.detach()
+
+
+# ---------------------------------------------------------------------------
+# live Supervisor legs (the 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rig8(mesh8):
+    from distributed_pytorch_training_tpu.resilience.__main__ import (
+        _build_rig,
+    )
+
+    # dataset 32 / global batch 16 -> 2 steps per epoch
+    return _build_rig(mesh8, seed=0, dataset_size=32, per_device_batch=2)
+
+
+def _retune_supervisor(rig8, mesh8, tuner_gate):
+    from distributed_pytorch_training_tpu.resilience.__main__ import (
+        _build_rig,
+    )
+    from distributed_pytorch_training_tpu.resilience.elastic import (
+        ElasticPlan,
+    )
+    from distributed_pytorch_training_tpu.resilience.supervisor import (
+        Supervisor,
+    )
+
+    trainer, state_factory, loader = rig8
+    tuner = PerfTuner(threshold=0.1, min_windows=1,
+                      candidate={"wire_dtype": "bf16"})
+    tuner.observe({"kind": DEVICE_PROFILE_KIND, "exposed_comm_ratio": 0.8})
+    ap = Autopilot(tuner=tuner, evict=False, gate=tuner_gate).attach()
+
+    def retune_cb(overrides):
+        t, sf, ld = _build_rig(mesh8, seed=0, dataset_size=32,
+                               per_device_batch=2,
+                               wire_dtype=overrides["wire_dtype"])
+        return ElasticPlan(trainer=t, loader=ld, state_factory=sf, world=8)
+
+    sup = Supervisor(trainer, None, state_factory, loader,
+                     checkpoint_every_steps=1, retune_cb=retune_cb,
+                     control=ap)
+    return sup, ap
+
+
+class TestBoundaryRetuneLive:
+    def test_gated_retune_applies_at_the_boundary(self, stream, rig8,
+                                                  mesh8):
+        """Loop (2) live: the tuner's proposal passes its (stubbed) gate
+        and the run continues at the same world on the new wire — the
+        re-plan landing ONLY at the segment boundary, moments carried,
+        no state leaf reset (bf16 wire adds no EF buffers)."""
+        sup, ap = _retune_supervisor(rig8, mesh8,
+                                     tuner_gate=lambda o: (True, []))
+        try:
+            state, report = sup.run(1)
+        finally:
+            ap.detach()
+        assert report.completed and report.final_step == 2
+        (rec,) = report.retunes
+        assert rec["overrides"] == {"wire_dtype": "bf16"}
+        assert (rec["epoch"], rec["step"]) == (0, 1)  # the mid-epoch anchor
+        assert rec["resets"] == []
+        assert sup.trainer.config.wire_dtype == "bf16"
+        assert sup.world_size == 8  # a retune never changes capacity
+        final = ap.decisions[-1]
+        assert final.action == "retune" and final.applied
+        spans = [e.get("name") for e in _tail(500)
+                 if e.get("kind") == "span"]
+        assert "control_retune" in spans and "control_apply" in spans
+
+    def test_refused_candidate_leaves_the_run_on_the_old_config(
+            self, stream, rig8, mesh8):
+        """The refusal twin: a failing contract refuses the candidate
+        with a logged decision and the run COMPLETES on fp32 — refusal
+        is an audit event, never an error."""
+        sup, ap = _retune_supervisor(
+            rig8, mesh8,
+            tuner_gate=lambda o: (False, ["hlo finding: wire drift"]))
+        try:
+            state, report = sup.run(1)
+        finally:
+            ap.detach()
+        assert report.completed and report.final_step == 2
+        assert report.retunes == []
+        assert sup.trainer.config.wire_dtype == "fp32"
+        (refuse,) = [d for d in ap.decisions if d.action == "refuse"]
+        assert refuse.evidence["refused_action"] == "retune"
+        assert refuse.evidence["refusals"] == ["hlo finding: wire drift"]
+
+
+class TestAutopilotOffPin:
+    def test_control_none_leaves_no_trace(self, stream, rig8):
+        """Off by default, NOTHING when off: a control=None supervised
+        run emits zero control events/spans and starts zero probe
+        threads — the stream is indistinguishable from a build without
+        the control package."""
+        from distributed_pytorch_training_tpu.resilience.supervisor import (
+            Supervisor,
+        )
+
+        trainer, state_factory, loader = rig8
+        before = _probe_threads()
+        sup = Supervisor(trainer, None, state_factory, loader,
+                         checkpoint_every_steps=1, control=None)
+        state, report = sup.run(1)
+        assert report.completed
+        evs = _tail(1000)
+        assert not [e for e in evs
+                    if e.get("kind") == CONTROL_DECISION_KIND]
+        assert not [e for e in evs if e.get("kind") == "span"
+                    and e.get("name") in ("control_apply",
+                                          "control_retune")]
+        assert _probe_threads() == before
+
+
+# ---------------------------------------------------------------------------
+# the acceptance e2e: chaos --autopilot, then every renderer of its stream
+# ---------------------------------------------------------------------------
+
+
+class TestAutopilotChaosE2E:
+    def test_detect_evict_grow_chain_with_bitwise_parity(self, tmp_path,
+                                                         capsys):
+        """ISSUE 20 acceptance: a persistent loader_stall straggler is
+        detected from the stream, evicted at a segment boundary (shrink
+        8 -> 4 via the elastic path — NO fault raised, zero restarts),
+        the returned capacity re-admitted by the boundary grow, and the
+        post-resize segment is BITWISE equal to a clean continuation.
+        The decision chain must be readable back off the stream file,
+        and the same artifact must render through /metrics and
+        `telemetry summary`."""
+        from distributed_pytorch_training_tpu.resilience.__main__ import (
+            main,
+        )
+
+        rc = main(["chaos", "--autopilot", "--ckpt-dir", str(tmp_path),
+                   "--json"])
+        stats = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert stats["autopilot"] is True
+        assert stats["completed"] is True
+        assert stats["parity_bitwise"] is True
+        # nothing crashed: the ONLY path to the resize was the control
+        # plane naming the straggler
+        assert stats["restarts"] == 0
+        assert [r["direction"] for r in stats["resizes"]] == \
+            ["shrink", "grow"]
+        shrink = stats["resizes"][0]
+        assert shrink["cause"] == "straggler_evict"
+        assert (shrink["from_world"], shrink["to_world"]) == (8, 4)
+        assert shrink["evicted_rank"] is not None
+        grow = stats["resizes"][1]
+        assert (grow["from_world"], grow["to_world"]) == (4, 8)
+
+        decisions = stats["control_decisions"]
+        actions = [d["action"] for d in decisions]
+        assert "detect" in actions and "grow" in actions
+        evict = next(d for d in decisions if d["action"] == "evict")
+        assert evict["applied"] is True
+        assert (evict["world_from"], evict["world_to"]) == (8, 4)
+        assert evict["rank"] == shrink["evicted_rank"]
+        # detect precedes its evict; the grow closes the chain
+        assert actions.index("detect") < actions.index("evict")
+        assert actions.index("evict") < actions.index("grow")
+        assert stats["flights_ok"] is True
+
+        # --- the renderers, fed the run's OWN stream artifact ---------
+        stream_path = Path(stats["ckpt_dir"]) / "telemetry_rank0.jsonl"
+        events = [json.loads(line) for line in
+                  stream_path.read_text().splitlines()]
+
+        from distributed_pytorch_training_tpu.telemetry.metrics_http import (
+            _MetricsState,
+        )
+
+        ms = _MetricsState()
+        for ev in events:
+            ms.observe(ev)
+        page = ms.render()
+        assert 'dpt_control_decisions_total{action="evict"} 1' in page
+        assert 'dpt_control_decisions_total{action="detect"}' in page
+        assert 'dpt_control_decisions_total{action="grow"} 1' in page
+
+        from distributed_pytorch_training_tpu.telemetry.__main__ import (
+            summarize,
+        )
+
+        s = summarize(events)["control_decisions"]
+        assert s["total"] == len(decisions)
+        assert s["by_action"]["evict"] == 1
+        assert [c["action"] for c in s["chain"]] == actions
+        # the decision spans are accounted next to their verdicts
+        assert summarize(events)["spans"].get("control_apply",
+                                              {}).get("count", 0) >= 1

@@ -18,6 +18,7 @@ from pathlib import Path
 import pytest
 
 from distributed_pytorch_training_tpu.resilience.fleet import (
+    DIST_COORD_ENV, DIST_NPROC_ENV, DIST_PROC_ID_ENV,
     FLEET_GENERATION_ENV, FLEET_RANK_ENV, FleetOrchestrator,
     _xla_flags_for, check_fleet_flights, checkpoint_progress,
 )
@@ -194,6 +195,127 @@ class TestOrchestrator:
         assert len(report.launches) == 3
         assert all(l["outcome"] == "drained" for l in report.launches)
         assert any("did not reach step" in e for e in report.errors)
+
+
+# Multi-host stub child (ISSUE 20): every rank records the rendezvous
+# contract it was stamped with; only rank 0 writes checkpoint progress
+# (as in a real run, where rank 0 owns the manifest). Per-rank exit
+# codes come from the plan's "rcs" list.
+MH_STUB = """\
+import json, os, sys
+from pathlib import Path
+
+gen = int(os.environ["{gen_env}"])
+rank = int(os.environ.get("{proc_env}", "0"))
+ckpt = Path(sys.argv[1])
+plans = json.loads(Path(sys.argv[2]).read_text())
+plan = plans[min(gen, len(plans) - 1)]
+ckpt.mkdir(parents=True, exist_ok=True)
+(ckpt / "mh_gen{{}}_rank{{}}.json".format(gen, rank)).write_text(
+    json.dumps({{
+        "args": sys.argv[3:],
+        "coord": os.environ.get("{coord_env}"),
+        "nproc": os.environ.get("{nproc_env}"),
+        "proc_id": os.environ.get("{proc_env}"),
+        "fleet_rank": os.environ.get("{rank_env}"),
+        "xla": os.environ.get("XLA_FLAGS", ""),
+    }}))
+if rank == 0 and plan.get("step") is not None:
+    mdir = ckpt / ".manifests"
+    mdir.mkdir(exist_ok=True)
+    (mdir / "{{}}.json".format(plan["label"])).write_text(json.dumps(
+        {{"step": plan["step"], "world_size": plan.get("world")}}))
+rcs = plan.get("rcs") or [plan.get("rc", 0)]
+sys.exit(rcs[min(rank, len(rcs) - 1)])
+""".format(gen_env=FLEET_GENERATION_ENV, rank_env=FLEET_RANK_ENV,
+           coord_env=DIST_COORD_ENV, nproc_env=DIST_NPROC_ENV,
+           proc_env=DIST_PROC_ID_ENV)
+
+
+class TestMultiHostGenerations:
+    """hosts > 1 (ISSUE 20): one generation spans `hosts` processes
+    rendezvousing through the stamped DPT_COORDINATOR_ADDRESS /
+    DPT_NUM_PROCESSES / DPT_PROCESS_ID contract."""
+
+    PORT = 7310
+
+    def _mh_orchestrator(self, tmp_path, plans, capacity, *, hosts=2,
+                         target_step=12, max_launches=8):
+        stub = tmp_path / "mh_stub_child.py"
+        stub.write_text(MH_STUB)
+        plan_file = tmp_path / "plans.json"
+        plan_file.write_text(json.dumps(plans))
+        ckpt = tmp_path / "ckpt"
+
+        def argv_for(world, generation, resume, rank):
+            # multi-host argv_for receives the child's rank explicitly
+            return [sys.executable, str(stub), str(ckpt), str(plan_file),
+                    f"world={world}", f"resume={resume}", f"rank={rank}"]
+
+        return FleetOrchestrator(
+            argv_for, ckpt, global_batch=16, target_step=target_step,
+            capacity_for=capacity, max_launches=max_launches,
+            hosts=hosts, coordinator_port=self.PORT,
+            log=lambda _m: None), ckpt
+
+    @staticmethod
+    def _mh_seen(ckpt, generation, rank):
+        return json.loads(
+            (ckpt / f"mh_gen{generation}_rank{rank}.json").read_text())
+
+    def test_requires_coordinator_port(self, tmp_path):
+        with pytest.raises(ValueError, match="coordinator_port"):
+            FleetOrchestrator(
+                lambda **_kw: [sys.executable, "-c", "pass"],
+                tmp_path / "ckpt", global_batch=16, target_step=12,
+                capacity_for=[8], hosts=2)
+
+    def test_topology_stamped_and_peers_collected(self, tmp_path):
+        """Every rank of a 2-host generation sees the same coordinator
+        address, nproc=2, its own process id, and world//hosts local
+        devices; rank 1's rc is collected into peer_rcs and its output
+        lands in a per-rank log."""
+        plans = [{"rc": 0, "label": 12, "step": 12, "world": 8}]
+        orch, ckpt = self._mh_orchestrator(tmp_path, plans, [8])
+        report = orch.run()
+        assert report.completed is True
+        assert len(report.launches) == 1
+        assert report.launches[0]["peer_rcs"] == [0]
+        for rank in (0, 1):
+            seen = self._mh_seen(ckpt, 0, rank)
+            assert seen["coord"] == f"127.0.0.1:{self.PORT}"
+            assert seen["nproc"] == "2"
+            assert seen["proc_id"] == str(rank)
+            # one generation at world 8 over 2 hosts: 4 local devices
+            assert ("--xla_force_host_platform_device_count=4"
+                    in seen["xla"])
+            assert f"rank={rank}" in seen["args"]
+        # FLEET_RANK stays the single-host restart-lineage rank (0 for
+        # every child of the generation); the collective rank is
+        # DPT_PROCESS_ID
+        assert self._mh_seen(ckpt, 0, 1)["fleet_rank"] == "1"
+        assert (ckpt / "fleet_logs" / "gen0_rank1.log").exists()
+
+    def test_peer_crash_downgrades_and_port_advances(self, tmp_path):
+        """Rank 0 exiting clean does not absolve a dead peer: the
+        generation is crashed and relaunched — and the relaunch
+        rendezvouses on coordinator_port + generation, never racing the
+        previous coordinator's socket."""
+        plans = [
+            {"rcs": [0, 1], "label": 4, "step": 4, "world": 8},
+            {"rcs": [0, 0], "label": 12, "step": 12, "world": 8},
+        ]
+        orch, ckpt = self._mh_orchestrator(tmp_path, plans, [8])
+        report = orch.run()
+        assert report.completed is True
+        assert [l["outcome"] for l in report.launches] == \
+            ["crashed", "completed"]
+        assert [l["peer_rcs"] for l in report.launches] == [[1], [0]]
+        assert [l["resume"] for l in report.launches] == [False, True]
+        for gen in (0, 1):
+            for rank in (0, 1):
+                assert self._mh_seen(ckpt, gen, rank)["coord"] == \
+                    f"127.0.0.1:{self.PORT + gen}"
 
 
 class TestFleetFlights:

@@ -199,6 +199,7 @@ def test_bucketed_adamw_matches_implicit(mesh8):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~7 s convergence smoke; bf16 wire lowering stays gated fast by the gsync_bf16/zero1_bf16 matrix contracts
 def test_bf16_wire_converges(mesh8):
     l_fp, _ = _run(mesh8, steps=6)
     l_bf, _ = _run(mesh8, steps=6, bucket_cap_mb=0.05, wire_dtype="bf16")
@@ -208,6 +209,7 @@ def test_bf16_wire_converges(mesh8):
     np.testing.assert_allclose(l_fp, l_bf, rtol=1e-2)
 
 
+@pytest.mark.slow  # ~10 s convergence smoke; int8 EF exactness stays fast via the multihop 20-step parity + pre-EF resume legs
 def test_int8_ef_converges_and_feedback_engages(mesh8):
     l_fp, _ = _run(mesh8, steps=8)
     l_i8, s_i8 = _run(mesh8, steps=8, bucket_cap_mb=0.05, wire_dtype="int8")
@@ -401,6 +403,7 @@ def test_multihop_parity_20_steps(mesh8):
     assert np.abs(ef).max() > 0.0
 
 
+@pytest.mark.slow  # ~9 s; the non-accum multihop parity stays fast and the accum interaction is gated by the gsync_int8_mh_accum matrix contract
 def test_multihop_parity_20_steps_grad_accum(mesh8):
     """ISSUE-4 acceptance, grad-accum ON: the residual is carried through
     the microbatch scan (each in-scan reduction quantizes and feeds back)
@@ -490,6 +493,7 @@ def test_zero1_multihop_parity_20_steps(mesh8):
     assert max(float(jnp.abs(l).max()) for l in ef_leaves) > 0.0
 
 
+@pytest.mark.slow  # ~9 s; strictly redundant with the zero1_int8_mh contract in the matrix gate (same census, same rules)
 def test_zero1_multihop_census_all_s8_no_checker_relaxation(mesh8):
     """BOTH halves off fp32 in the lowered HLO: the gradient-sized wire is
     s8 all-to-all (scatter) + s8 all-gather (the delta-compressed param
@@ -564,6 +568,7 @@ def _lower(mesh, **cfg):
     return lowered, lowered.compile().as_text(), s
 
 
+@pytest.mark.slow  # ~7 s; strictly redundant with the gsync_fp32 contract in the matrix gate
 def test_census_bucket_bound_fp32(mesh8):
     from distributed_pytorch_training_tpu.experiments.trace_analysis import (
         grad_sync_census, verify_grad_sync_collectives,
@@ -615,6 +620,7 @@ def test_census_int8_on_the_wire(mesh8):
         wire_dtype="int8", min_elements=128)
 
 
+@pytest.mark.slow  # ~5 s; strictly redundant with the gsync_int8_mh contract in the matrix gate
 def test_census_int8_multihop_two_per_bucket(mesh8):
     """ISSUE-4 acceptance: the compiled multihop step carries exactly
     2 x ceil(bytes/cap) gradient-sized collectives (+slack 2) with the

@@ -123,6 +123,7 @@ def test_hier_parity_20_steps(hier_mesh):
     assert np.abs(ef).max() > 0.0
 
 
+@pytest.mark.slow  # ~9 s; the non-accum hier parity stays fast and the accum interaction is gated by the gsync_int8_hier_accum matrix contract
 def test_hier_parity_20_steps_grad_accum(hier_mesh):
     """Grad-accum ON: the slow-tier residual is carried through the
     microbatch scan. Per-step bound coarse, time-averaged tail tight —
@@ -414,6 +415,7 @@ class TestHierGuards:
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # ~5 s; strictly redundant with the gsync_int8_hier contract in the matrix gate
 def test_gsync_hier_contract_clean_and_tier_pure(devices):
     """The ISSUE-16 acceptance contract, evaluated directly: the lowered
     step is clean under the FULL rule suite and its census is tier-pure —

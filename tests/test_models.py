@@ -114,6 +114,7 @@ def test_remat_preserves_values_and_grads():
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g1, g2)
 
 
+@pytest.mark.slow  # ~7 s apply smoke; remat exactness stays fast via test_remat_preserves_values_and_grads, bert/vit forwards ride the LM-task suites
 def test_remat_bert_and_vit_apply():
     import jax
     import jax.numpy as jnp

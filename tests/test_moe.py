@@ -73,6 +73,7 @@ def test_capacity_scales_with_top_k():
     np.testing.assert_allclose(y_tight, y_ample, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # ~5 s convergence smoke; routing/dispatch exactness stays fast via the sorted-dispatch parity legs
 def test_router_noise_trains_through_lm_task():
     """router_noise > 0 at train time must not raise (ADVICE r1: the task
     previously omitted the rngs dict, so make_rng('dropout') failed) and must

@@ -692,6 +692,7 @@ class TestSupervisor:
         assert report.faults_fired == ["loader_stall@step=1:0.2s"]
         assert int(state.step) == 4
 
+    @pytest.mark.slow  # ~7 s; restart/resize/flight accounting stays fast via the chaos CLI bidirectional e2e, jitter via the RetryPolicy unit legs
     def test_elastic_resize_one_restart_one_flight_deterministic_jitter(
             self, rig, tmp_path):
         """ISSUE-11 satellite: a restart that RESIZES rides the normal

@@ -95,6 +95,7 @@ def _assert_params_close(a, b, **tol):
         a.params, b.params)
 
 
+@pytest.mark.slow  # ~6 s; the adamw leg stays fast and is the stricter parity (two moments + bias correction through the sharded update)
 def test_zero1_sgd_momentum_matches_replicated(mesh8):
     (l_rep, s_rep), (l_z1, s_z1) = _run_pair(mesh8, "sgd")
     np.testing.assert_allclose(l_rep, l_z1, rtol=2e-5)
@@ -161,6 +162,7 @@ def test_zero1_padded_batch_rows(mesh8):
     np.testing.assert_allclose(l_rep, l_z1, rtol=2e-5)
 
 
+@pytest.mark.slow  # ~8 s; strictly redundant with the zero1 contract in the matrix gate (same census, same rules)
 def test_zero1_hlo_census_reduce_scatter_replaces_all_reduce(mesh8):
     """The acceptance check: the compiled zero1 step carries NO gradient-
     sized all-reduce; reduce-scatter + all-gather appear instead. Scalar

@@ -97,6 +97,16 @@ def main(argv=None):
     if args.max_restarts < 0:
         raise ValueError(f"--max-restarts must be >= 0, got "
                          f"{args.max_restarts}")
+    if args.autopilot and args.max_restarts <= 0:
+        raise ValueError("--autopilot requires --max-restarts (the "
+                         "Supervisor owns the segment boundaries every "
+                         "control decision is anchored at)")
+    if args.autopilot and args.no_telemetry:
+        raise ValueError("--autopilot requires telemetry: the control "
+                         "plane's inputs AND its decision log are both "
+                         "the stream (drop --no-telemetry)")
+    if args.autopilot_tune and not args.autopilot:
+        raise ValueError("--autopilot-tune requires --autopilot")
 
     # Preemption guard first: a SIGTERM during data load / compile must also
     # lead to a graceful stop, not a mid-init kill (preemption.py docstring).
@@ -781,6 +791,44 @@ def _run(args, guard):
             csv.append(epoch, train_loss, train_acc, val_loss, val_acc,
                        epoch_time)
 
+        # Control-plane autopilot (ISSUE 20): constructed ONLY under
+        # --autopilot — off means no object, no observer, no threads, and
+        # a recorder stream/HLO byte-identical to a build without the
+        # control package. Eviction decisions on this fixed-world
+        # supervisor are refused by the re-plan surface (no replan_cb)
+        # and logged as `refuse` records — the audit trail still shows
+        # what the policy wanted; the chaos harness proves the applied
+        # path on its elastic rig.
+        autopilot = None
+        retune_cb = None
+        if args.autopilot:
+            from distributed_pytorch_training_tpu.control import (
+                Autopilot, PerfTuner,
+            )
+            if args.autopilot_tune:
+                import dataclasses as _dc
+
+                from distributed_pytorch_training_tpu.resilience.elastic \
+                    import ElasticPlan
+
+                def retune_cb(overrides):
+                    # same world, same loader, same optimizer — only the
+                    # TrainConfig re-plans; boundary_retune carries every
+                    # state leaf the new config keeps the layout of
+                    new_trainer = Trainer(
+                        task, mesh,
+                        _dc.replace(trainer.config, **overrides),
+                        rules=rules)
+                    return ElasticPlan(
+                        trainer=new_trainer, loader=train_loader,
+                        state_factory=lambda: new_trainer.init_state(
+                            model, sample_input, tx,
+                            jax.random.PRNGKey(args.seed)),
+                        world=new_trainer.batch_shards)
+            autopilot = Autopilot(
+                tuner=PerfTuner() if args.autopilot_tune else None
+            ).attach()
+
         # trust_existing=args.resume: a fresh run pointed at a directory
         # holding a previous run's checkpoints must never restore one
         # mid-recovery (only --resume opts into the directory's history)
@@ -788,9 +836,21 @@ def _run(args, guard):
                          retry=RetryPolicy(max_restarts=args.max_restarts),
                          guard=guard, injector=chaos,
                          trust_existing=args.resume,
-                         epoch_end_cb=epoch_end, deathwatch=relay_watch)
-        state, report = sup.run(args.epochs,
-                                initial=(state, start_epoch, start_step))
+                         epoch_end_cb=epoch_end, deathwatch=relay_watch,
+                         control=autopilot, retune_cb=retune_cb)
+        try:
+            state, report = sup.run(args.epochs,
+                                    initial=(state, start_epoch,
+                                             start_step))
+        finally:
+            if autopilot is not None:
+                autopilot.detach()
+        if autopilot is not None and autopilot.decisions:
+            acts = ", ".join(f"{d.action}"
+                             + ("[applied]" if d.applied else "")
+                             for d in autopilot.decisions)
+            log_main(f"Autopilot: {len(autopilot.decisions)} control "
+                     f"decision(s): {acts}")
         log_main(f"Supervisor: completed={report.completed} "
                  f"restarts={report.restarts} "
                  f"steps_replayed={report.steps_replayed} "
